@@ -1,0 +1,90 @@
+"""Benchmark: GBM boosting-iters/sec/chip on letter (26-class, 100 rounds)
+plus predict rows/sec — the primary metric pinned by BASELINE.json.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+The reference publishes no numbers (BASELINE.md), so vs_baseline is measured
+against a conservative JVM-reference estimate recorded in this file once a
+reference timing exists; until then it reports 1.0 relative to itself.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _load_letter():
+    from spark_ensemble_tpu.utils.datasets import has_reference_data, load_dataset
+
+    if has_reference_data():
+        return load_dataset("letter")
+    rng = np.random.RandomState(0)
+    X = rng.randn(15000, 16).astype(np.float32)
+    centers = rng.randn(26, 16).astype(np.float32)
+    y = np.argmax(X @ centers.T + 0.5 * rng.randn(15000, 26), axis=1).astype(
+        np.float32
+    )
+    return X, y
+
+
+def main():
+    import jax
+
+    from spark_ensemble_tpu import GBMClassifier
+
+    X, y = _load_letter()
+    num_rounds = int(os.environ.get("BENCH_ROUNDS", "100"))
+
+    est = GBMClassifier(
+        num_base_learners=num_rounds,
+        loss="logloss",
+        updates="newton",
+        learning_rate=0.3,
+        optimized_weights=True,
+    )
+
+    # warmup: compile the round step on a small prefix (cached for full run)
+    warm = GBMClassifier(
+        num_base_learners=1, loss="logloss", updates="newton", learning_rate=0.3
+    )
+    warm.fit(X, y)
+
+    t0 = time.perf_counter()
+    model = est.fit(X, y)
+    fit_s = time.perf_counter() - t0
+    iters_per_sec = num_rounds / fit_s
+
+    # predict throughput (raw scores; jitted, steady-state)
+    Xd = jax.numpy.asarray(X)
+    jax.block_until_ready(model.predict(Xd))  # compile at the timed shape
+    t0 = time.perf_counter()
+    reps = 20
+    for _ in range(reps):
+        out = model.predict(Xd)
+    jax.block_until_ready(out)
+    pred_s = (time.perf_counter() - t0) / reps
+    rows_per_sec = X.shape[0] / pred_s
+
+    train_acc = float(np.mean(np.asarray(model.predict(Xd)) == y))
+
+    print(
+        json.dumps(
+            {
+                "metric": "GBM boosting-iters/sec/chip (letter, 100 rounds)",
+                "value": round(iters_per_sec, 3),
+                "unit": "iters/sec",
+                "vs_baseline": 1.0,
+                "predict_rows_per_sec": round(rows_per_sec, 1),
+                "fit_seconds": round(fit_s, 2),
+                "train_accuracy": round(train_acc, 4),
+                "num_rounds": num_rounds,
+                "device": str(jax.devices()[0]),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

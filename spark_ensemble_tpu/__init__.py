@@ -1,0 +1,99 @@
+"""TPU-native ensemble-learning framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of
+pierrenodet/spark-ensemble (Scala/Spark meta-estimators): Bagging (SubBag),
+Boosting (AdaBoost SAMME / SAMME.R / Drucker R2), Gradient Boosting Machines
+(gradient & Newton updates, line-searched step sizes, early stopping,
+stochastic subbagging) and Stacking, for classification and regression, over
+pluggable base learners.
+
+Where the reference runs inner loops as Spark RDD jobs on JVM executors
+(reference: `core/src/main/scala/org/apache/spark/ml/...`), this framework
+compiles them to XLA: base-learner fits are vmapped across ensemble members
+and class dims, rows are sharded over a `jax.sharding.Mesh`, and reductions
+use `psum` over ICI instead of Spark `treeAggregate`.
+"""
+
+from spark_ensemble_tpu.models.bagging import (
+    BaggingClassificationModel,
+    BaggingClassifier,
+    BaggingRegressionModel,
+    BaggingRegressor,
+)
+from spark_ensemble_tpu.models.boosting import (
+    BoostingClassificationModel,
+    BoostingClassifier,
+    BoostingRegressionModel,
+    BoostingRegressor,
+)
+from spark_ensemble_tpu.models.dummy import (
+    DummyClassificationModel,
+    DummyClassifier,
+    DummyRegressionModel,
+    DummyRegressor,
+)
+from spark_ensemble_tpu.models.gbm import (
+    GBMClassificationModel,
+    GBMClassifier,
+    GBMRegressionModel,
+    GBMRegressor,
+)
+from spark_ensemble_tpu.models.linear import (
+    LinearRegression,
+    LinearRegressionModel,
+    LogisticRegression,
+    LogisticRegressionModel,
+)
+from spark_ensemble_tpu.models.naive_bayes import (
+    GaussianNaiveBayes,
+    GaussianNaiveBayesModel,
+)
+from spark_ensemble_tpu.models.stacking import (
+    StackingClassificationModel,
+    StackingClassifier,
+    StackingRegressionModel,
+    StackingRegressor,
+)
+from spark_ensemble_tpu.models.tree import (
+    DecisionTreeClassificationModel,
+    DecisionTreeClassifier,
+    DecisionTreeRegressionModel,
+    DecisionTreeRegressor,
+)
+from spark_ensemble_tpu.utils.persist import load
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "BaggingClassifier",
+    "BaggingClassificationModel",
+    "BaggingRegressor",
+    "BaggingRegressionModel",
+    "BoostingClassifier",
+    "BoostingClassificationModel",
+    "BoostingRegressor",
+    "BoostingRegressionModel",
+    "GBMClassifier",
+    "GBMClassificationModel",
+    "GBMRegressor",
+    "GBMRegressionModel",
+    "StackingClassifier",
+    "StackingClassificationModel",
+    "StackingRegressor",
+    "StackingRegressionModel",
+    "DummyClassifier",
+    "DummyClassificationModel",
+    "DummyRegressor",
+    "DummyRegressionModel",
+    "DecisionTreeClassifier",
+    "DecisionTreeClassificationModel",
+    "DecisionTreeRegressor",
+    "DecisionTreeRegressionModel",
+    "LinearRegression",
+    "LinearRegressionModel",
+    "LogisticRegression",
+    "LogisticRegressionModel",
+    "GaussianNaiveBayes",
+    "GaussianNaiveBayesModel",
+    "load",
+]

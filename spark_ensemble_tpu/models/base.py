@@ -1,0 +1,164 @@
+"""Estimator/Model base classes and the BaseLearner functional protocol.
+
+This is the TPU build's **execution-backend seam** — the analogue of the
+reference's ``HasBaseLearner.fitBaseLearner`` funnel
+(`ensembleParams.scala:64-81`), through which every ensemble trains every
+base model.  Where the reference rebinds DataFrame columns and calls
+``baseLearner.fit(df, paramMap)`` (one Spark job per member), here a base
+learner exposes a *pure functional* triple:
+
+  - ``make_fit_ctx(X, num_classes)``: shared preprocessing computed once per
+    ensemble fit (e.g. quantile binning for trees) — hoisted out of the
+    member loop so members share it;
+  - ``fit_from_ctx(ctx, y, w, feature_mask, key) -> params``: a pure,
+    jit-compiled, **vmappable** fit over fixed-shape arrays.  Row sampling
+    arrives via ``w`` (Poisson/Bernoulli weights) and feature subspaces via
+    ``feature_mask`` — the static-shape encoding of the reference's
+    ``RDD.sample`` + ``slice`` (`HasSubBag.scala:73-84`);
+  - ``predict_fn(params, X)`` (+ ``predict_raw_fn``/``predict_proba_fn`` for
+    classifiers): pure predict, vmappable over a stacked member axis.
+
+Ensembles vmap ``fit_from_ctx`` over ``(key, w, feature_mask)`` to train all
+members in one XLA program — replacing the reference's driver thread-pool
+``Future`` parallelism (`BaggingClassifier.scala:180-201`).
+
+Weight support mirrors the reference's dispatch on ``HasWeightCol``
+(`ensembleParams.scala:64-81`): all built-in learners support weights;
+a learner may set ``supports_weight = False`` and ensembles will warn and
+drop weights, like `StackingClassifier.scala:147-150`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_ensemble_tpu.params import Params
+
+
+def as_f32(x) -> jax.Array:
+    return jnp.asarray(x, dtype=jnp.float32)
+
+
+def resolve_weights(y: jax.Array, sample_weight) -> jax.Array:
+    if sample_weight is None:
+        return jnp.ones_like(y, dtype=jnp.float32)
+    return as_f32(sample_weight)
+
+
+def infer_num_classes(y) -> int:
+    return int(np.asarray(y).max()) + 1
+
+
+class Model(Params):
+    """A fitted model: estimator config + learned params pytree."""
+
+    def __init__(self, params: Any = None, num_features: int = 0, **kwargs):
+        super().__init__(**kwargs)
+        self.params = params
+        self.num_features = num_features
+
+    def predict(self, X) -> jax.Array:
+        raise NotImplementedError
+
+    def _cached_jit(self, name: str, builder):
+        """Per-instance jit cache: model predict paths are built once and
+        reused across calls (a fresh vmap/jit per call would retrace)."""
+        cache = getattr(self, "_jit_cache", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(self, "_jit_cache", cache)
+        if name not in cache:
+            cache[name] = jax.jit(builder)
+        return cache[name]
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_jit_cache", None)
+        return state
+
+    def save(self, path: str):
+        from spark_ensemble_tpu.utils import persist
+
+        persist.save(self, path)
+
+
+class RegressionModel(Model):
+    pass
+
+
+class ClassificationModel(Model):
+    """Adds raw scores / probabilities (reference: ProbabilisticClassifier)."""
+
+    def __init__(self, num_classes: int = 2, **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+
+    def predict_raw(self, X) -> jax.Array:
+        raise NotImplementedError
+
+    def predict_proba(self, X) -> jax.Array:
+        raise NotImplementedError
+
+    def predict(self, X) -> jax.Array:
+        return jnp.argmax(self.predict_proba(X), axis=-1).astype(jnp.float32)
+
+
+class Estimator(Params):
+    """Base estimator: ``fit(X, y, sample_weight) -> Model``."""
+
+    is_classifier = False
+    supports_weight = True
+
+    def fit(self, X, y, sample_weight=None) -> Model:
+        raise NotImplementedError
+
+
+class BaseLearner(Estimator):
+    """An estimator trainable through the functional member protocol."""
+
+    def make_fit_ctx(self, X: jax.Array, num_classes: Optional[int] = None) -> Any:
+        """Shared preprocessing (binning, feature stats); pure pytree out."""
+        return as_f32(X)
+
+    def fit_from_ctx(
+        self,
+        ctx: Any,
+        y: jax.Array,
+        w: jax.Array,
+        feature_mask: Optional[jax.Array],
+        key: jax.Array,
+    ) -> Any:
+        """Pure, jittable, vmappable member fit -> params pytree."""
+        raise NotImplementedError
+
+    def predict_fn(self, params: Any, X: jax.Array) -> jax.Array:
+        """Regression value [n] (regressors) or class index f32[n] (classifiers)."""
+        raise NotImplementedError
+
+    def predict_raw_fn(self, params: Any, X: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def predict_proba_fn(self, params: Any, X: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def model_from_params(
+        self, params: Any, num_features: int, num_classes: Optional[int] = None
+    ) -> Model:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # standalone sklearn-style fit built on the functional protocol
+    # ------------------------------------------------------------------
+    def fit(self, X, y, sample_weight=None) -> Model:
+        X = as_f32(X)
+        y = as_f32(y)
+        w = resolve_weights(y, sample_weight)
+        num_classes = infer_num_classes(y) if self.is_classifier else None
+        ctx = self.make_fit_ctx(X, num_classes)
+        key = jax.random.PRNGKey(getattr(self, "seed", 0) or 0)
+        params = self.fit_from_ctx(ctx, y, w, None, key)
+        return self.model_from_params(params, X.shape[1], num_classes)

@@ -1,0 +1,116 @@
+"""Dummy baseline learners (reference: `DummyRegressor.scala`, `DummyClassifier.scala`).
+
+Used standalone as baselines and, critically, as GBM's init model
+(`GBMRegressor.scala:287-303`, `GBMClassifier.scala:275-288`).  Strategies:
+
+- DummyRegressor: mean | median | quantile(q) | constant(c)
+  (`DummyRegressor.scala:113-129`; quantile via Spark ``approxQuantile`` —
+  ours is the exact weighted quantile kernel).
+- DummyClassifier: uniform | prior | constant(c)
+  (`DummyClassifier.scala:90-123`; raw prediction = log(probability)).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from spark_ensemble_tpu.models.base import (
+    BaseLearner,
+    ClassificationModel,
+    RegressionModel,
+    as_f32,
+)
+from spark_ensemble_tpu.params import Param, gt_eq, in_array, in_range
+from spark_ensemble_tpu.utils.quantile import weighted_median, weighted_quantile
+
+
+class DummyRegressor(BaseLearner):
+    strategy = Param("mean", in_array(["mean", "median", "quantile", "constant"]))
+    quantile = Param(0.5, in_range(0.0, 1.0))
+    constant = Param(0.0)
+    tol = Param(1e-3, gt_eq(0.0), doc="kept for API parity; quantiles are exact")
+
+    is_classifier = False
+
+    def make_fit_ctx(self, X, num_classes=None):
+        return None
+
+    def fit_from_ctx(self, ctx, y, w, feature_mask, key):
+        strategy = self.strategy.lower()
+        if strategy == "mean":
+            value = jnp.sum(w * y) / jnp.maximum(jnp.sum(w), 1e-30)
+        elif strategy == "median":
+            value = weighted_median(y, w)
+        elif strategy == "quantile":
+            value = weighted_quantile(y, self.quantile, w)
+        else:
+            value = jnp.asarray(self.constant, jnp.float32)
+        return {"value": as_f32(value)}
+
+    def predict_fn(self, params, X):
+        return jnp.broadcast_to(params["value"], (X.shape[0],))
+
+    def model_from_params(self, params, num_features, num_classes=None):
+        return DummyRegressionModel(
+            params=params, num_features=num_features, **self.get_params()
+        )
+
+
+class DummyRegressionModel(RegressionModel, DummyRegressor):
+    def predict(self, X):
+        return self.predict_fn(self.params, as_f32(X))
+
+
+class DummyClassifier(BaseLearner):
+    strategy = Param("prior", in_array(["uniform", "prior", "constant"]))
+    constant = Param(0.0)
+
+    is_classifier = True
+
+    def make_fit_ctx(self, X, num_classes=None):
+        return {"num_classes": num_classes}
+
+    def fit_from_ctx(self, ctx, y, w, feature_mask, key):
+        k = ctx["num_classes"]
+        strategy = self.strategy.lower()
+        if strategy == "uniform":
+            proba = jnp.full((k,), 1.0 / k, jnp.float32)
+        elif strategy == "prior":
+            onehot = jax.nn.one_hot(y.astype(jnp.int32), k)
+            counts = jnp.sum(w[:, None] * onehot, axis=0)
+            proba = counts / jnp.maximum(jnp.sum(counts), 1e-30)
+        else:
+            proba = jax.nn.one_hot(jnp.asarray(self.constant, jnp.int32), k)
+        # reference: rawPrediction = log(probability) (`DummyClassifier.scala:100-116`)
+        raw = jnp.log(jnp.maximum(proba, 1e-30))
+        return {"proba": proba, "raw": raw}
+
+    def predict_proba_fn(self, params, X):
+        return jnp.broadcast_to(params["proba"], (X.shape[0],) + params["proba"].shape)
+
+    def predict_raw_fn(self, params, X):
+        return jnp.broadcast_to(params["raw"], (X.shape[0],) + params["raw"].shape)
+
+    def predict_fn(self, params, X):
+        return jnp.argmax(self.predict_proba_fn(params, X), axis=-1).astype(
+            jnp.float32
+        )
+
+    def model_from_params(self, params, num_features, num_classes=None):
+        return DummyClassificationModel(
+            params=params,
+            num_features=num_features,
+            num_classes=num_classes or 2,
+            **self.get_params(),
+        )
+
+
+class DummyClassificationModel(ClassificationModel, DummyClassifier):
+    def predict_proba(self, X):
+        return self.predict_proba_fn(self.params, as_f32(X))
+
+    def predict_raw(self, X):
+        return self.predict_raw_fn(self.params, as_f32(X))

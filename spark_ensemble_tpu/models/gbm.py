@@ -1,0 +1,622 @@
+"""Gradient Boosting Machines (regressor + multiclass classifier), TPU-native.
+
+Re-designs the reference's GBM loop (`GBMRegressor.scala:237-476`,
+`GBMClassifier.scala:219-496`) for XLA:
+
+- The per-round step — subbag sampling, pseudo-residual computation
+  (gradient or Newton with the reference's ``max(h, 1e-2)`` hessian floor and
+  ``0.5 * h / sum_h * w`` weight scaling), histogram-tree fit, line search,
+  prediction update — compiles to ONE jitted XLA program, traced once and
+  reused every round.  The reference instead launches several Spark jobs per
+  round (sample, residual map, base fit, N Brent evaluations, update).
+- Row sampling keeps static shapes: Poisson/Bernoulli *bag weights* instead
+  of materialized subsets (same estimator statistics as ``RDD.sample``);
+  feature subspaces are boolean masks zeroing split gains instead of sliced
+  vectors (`HasSubBag.scala:73-84`).
+- Line search runs on-device: Brent over [0, 100] for dim=1
+  (reference: commons-math ``BrentOptimizer``, `GBMRegressor.scala:311,413`)
+  and projected Newton over the box [0, inf)^K for multiclass (reference:
+  breeze ``LBFGSB``, `GBMClassifier.scala:290-292,427`).  Matching the
+  reference's aggregator (`GBMLoss.scala:50-74`), the objective weights rows
+  by their bag multiplicity only.
+- The K per-class trees the reference fits in parallel driver Futures
+  (`GBMClassifier.scala:377-411`) are a single ``vmap`` over the class dim.
+- Validation early-stop (patience ``num_rounds``, tolerance ``validation_tol``
+  with the reference's ``max(err, 0.01)`` scaling) runs in the host round
+  loop; the final model trims to ``i - v`` members like ``take(i - v)``
+  (`GBMRegressor.scala:474`).
+- Huber's adaptive delta re-estimates the alpha-quantile of absolute
+  residuals each round with the exact sort-based quantile kernel (reference:
+  distributed ``approxQuantile``, `GBMRegressor.scala:342-353`).
+
+The round loop itself stays on the host (data-dependent stopping), carrying
+predictions as device arrays — the analogue of the reference's RDD lineage,
+minus the need for ``PeriodicRDDCheckpointer``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_ensemble_tpu.models.base import (
+    BaseLearner,
+    ClassificationModel,
+    Estimator,
+    RegressionModel,
+    as_f32,
+    infer_num_classes,
+    resolve_weights,
+)
+from spark_ensemble_tpu.models.dummy import DummyClassifier, DummyRegressor
+from spark_ensemble_tpu.models.tree import DecisionTreeRegressor
+from spark_ensemble_tpu.ops import losses as losses_mod
+from spark_ensemble_tpu.ops.linesearch import brent_minimize, projected_newton_box
+from spark_ensemble_tpu.params import Param, gt, gt_eq, in_array, in_range
+from spark_ensemble_tpu.utils.instrumentation import Instrumentation
+from spark_ensemble_tpu.utils.quantile import weighted_quantile
+from spark_ensemble_tpu.utils.random import (
+    bootstrap_weights,
+    subspace_mask,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def stack_pytrees(trees: List[Any]):
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def index_pytree(tree: Any, i):
+    return jax.tree_util.tree_map(lambda x: x[i], tree)
+
+
+def slice_pytree(tree: Any, n: int):
+    return jax.tree_util.tree_map(lambda x: x[:n], tree)
+
+
+class _GBMParams(Estimator):
+    """Shared GBM params (reference `GBMParams.scala:29-137` defaults)."""
+
+    base_learner = Param(None, is_estimator=True)
+    num_base_learners = Param(10, gt_eq(1))
+    learning_rate = Param(1.0, gt(0.0))
+    optimized_weights = Param(True)
+    updates = Param("gradient", in_array(["gradient", "newton"]))
+    subsample_ratio = Param(1.0, in_range(0.0, 1.0, lower_inclusive=False))
+    replacement = Param(False)
+    subspace_ratio = Param(1.0, in_range(0.0, 1.0, lower_inclusive=False))
+    max_iter = Param(100, gt_eq(1))
+    tol = Param(1e-6, gt_eq(0.0))
+    num_rounds = Param(1, gt_eq(1))
+    validation_tol = Param(0.01, gt_eq(0.0))
+    seed = Param(0)
+    aggregation_depth = Param(2, gt_eq(1), doc="API parity; reductions are psum")
+    checkpoint_interval = Param(10, gt_eq(1))
+    checkpoint_dir = Param(
+        None,
+        doc="when set, training state (round, members, predictions, patience) "
+        "is checkpointed every checkpoint_interval rounds and fit() resumes "
+        "from the latest checkpoint — the TPU upgrade of the reference's "
+        "lineage-only PeriodicRDDCheckpointer (SURVEY.md §5)",
+    )
+
+    def _base(self) -> BaseLearner:
+        return self.base_learner or DecisionTreeRegressor()
+
+    def _sampling_plan(self, n: int, d: int):
+        """Per-member (bag-weight key, feature mask); member seeds mirror the
+        reference's ``seed + i`` discipline (`GBMRegressor.scala:282-284`)."""
+        root = jax.random.PRNGKey(self.seed)
+        masks = []
+        bag_keys = []
+        for i in range(self.num_base_learners):
+            k = jax.random.fold_in(root, i)
+            masks.append(subspace_mask(jax.random.fold_in(k, 1), d, self.subspace_ratio))
+            bag_keys.append(jax.random.fold_in(k, 2))
+        return jnp.stack(bag_keys), jnp.stack(masks)
+
+    def _resume_identity(self):
+        """Params that must match for a checkpoint to be resumable; budget
+        and checkpointing knobs are excluded so a run can be resumed with a
+        larger member budget or different checkpoint cadence."""
+        p = self.params_to_json_dict()
+        for k in ("num_base_learners", "checkpoint_interval", "checkpoint_dir"):
+            p.pop(k, None)
+        return p
+
+    @staticmethod
+    def _patience_step(best: float, err: float, v: int, validation_tol: float):
+        """Reference early-stop bookkeeping (`GBMRegressor.scala:457-465`)."""
+        if best - err < validation_tol * max(err, 0.01):
+            return best, v + 1
+        return err, 0
+
+
+def _pseudo_residuals_and_weights(
+    loss, updates, y_enc, pred, bag_w, w, axis_name=None
+):
+    """Targets/weights for the round's base fit (`GBMRegressor.scala:368-385`,
+    `GBMClassifier.scala:337-375`).  Returns (labels[n, dim], fit_w[n, dim]).
+    With ``axis_name`` the hessian sum reduces across data shards (the
+    reference's element-wise treeReduce, `GBMClassifier.scala:344-355`)."""
+    neg_grad = loss.negative_gradient(y_enc, pred)
+    if updates == "newton" and loss.has_hessian:
+        h = jnp.maximum(loss.hessian(y_enc, pred), 1e-2)
+        sum_h = jnp.sum(bag_w[:, None] * h, axis=0, keepdims=True)
+        if axis_name is not None:
+            sum_h = jax.lax.psum(sum_h, axis_name)
+        labels = neg_grad / h
+        fit_w = 0.5 * h / jnp.maximum(sum_h, 1e-30) * (w * bag_w)[:, None]
+    else:
+        labels = neg_grad
+        fit_w = jnp.broadcast_to((w * bag_w)[:, None], neg_grad.shape)
+    return labels, fit_w
+
+
+class GBMRegressor(_GBMParams):
+    """Friedman GBM regressor (reference `GBMRegressor.scala`)."""
+
+    loss = Param(
+        "squared",
+        in_array(
+            ["squared", "absolute", "huber", "quantile", "logcosh", "scaledlogcosh"]
+        ),
+        doc="reference-supported: squared|absolute|huber|quantile; logcosh and "
+        "scaledlogcosh are exposed as extensions (present in GBMLoss.scala "
+        "but not surfaced by GBMRegressorParams)",
+    )
+    alpha = Param(0.9, in_range(0.0, 1.0))
+    init_strategy = Param("constant", in_array(["constant", "zero", "base"]))
+
+    is_classifier = False
+
+    def _make_loss(self, delta):
+        name = self.loss.lower()
+        if name == "huber":
+            return losses_mod.HuberLoss(delta)
+        if name == "quantile":
+            return losses_mod.QuantileLoss(self.alpha)
+        if name == "scaledlogcosh":
+            return losses_mod.ScaledLogCoshLoss(self.alpha)
+        return losses_mod.get_regression_loss(name)
+
+    def _fit_init(self, X, y, w):
+        """Init model (`GBMRegressor.scala:287-303`)."""
+        strategy = self.init_strategy.lower()
+        if strategy == "base":
+            return self._base().fit(X, y, sample_weight=w)
+        if strategy == "zero":
+            return DummyRegressor(strategy="constant", constant=0.0).fit(X, y, w)
+        name = self.loss.lower()
+        if name in ("absolute", "huber"):
+            dummy = DummyRegressor(strategy="median")
+        elif name == "quantile":
+            dummy = DummyRegressor(strategy="quantile", quantile=self.alpha)
+        else:
+            dummy = DummyRegressor(strategy="mean")
+        return dummy.fit(X, y, sample_weight=w)
+
+    def fit(self, X, y, sample_weight=None, validation_indicator=None):
+        X = as_f32(X)
+        y = as_f32(y)
+        w_all = resolve_weights(y, sample_weight)
+        if validation_indicator is not None:
+            vi = np.asarray(validation_indicator, bool)
+            X_val, y_val = X[vi], y[vi]
+            X, y, w = X[~vi], y[~vi], w_all[~vi]
+        else:
+            X_val = y_val = None
+            w = w_all
+        n, d = X.shape
+        instr = Instrumentation("GBMRegressor.fit")
+        instr.log_params(self.get_params())
+        instr.log_dataset(n, d)
+        base = self._base()
+        ctx = base.make_fit_ctx(X)
+        bag_keys, masks = self._sampling_plan(n, d)
+
+        init_model = self._fit_init(X, y, w)
+        pred = init_model.predict(X)
+        huber = self.loss.lower() == "huber"
+        # initial huber delta: alpha-quantile of the label over the full
+        # input (reference `GBMRegressor.scala:305-308` uses `dataset`)
+        if huber:
+            full_y = jnp.concatenate([y, y_val]) if y_val is not None else y
+            delta = weighted_quantile(full_y, self.alpha)
+        else:
+            delta = jnp.asarray(0.0, jnp.float32)
+
+        updates = self.updates.lower()
+        optimized = bool(self.optimized_weights)
+        lr = float(self.learning_rate)
+        sub_ratio = float(self.subsample_ratio)
+        repl = bool(self.replacement)
+        tol = float(self.tol)
+        max_iter = int(self.max_iter)
+
+        def round_step(bag_key, mask, pred, delta, y, w):
+            loss = self._make_loss(delta)
+            y_enc = loss.encode_label(y)
+            bag_w = bootstrap_weights(bag_key, y.shape[0], repl, sub_ratio)
+            labels, fit_w = _pseudo_residuals_and_weights(
+                loss, updates, y_enc, pred[:, None], bag_w, w
+            )
+            params = base.fit_from_ctx(ctx, labels[:, 0], fit_w[:, 0], mask, bag_key)
+            direction = base.predict_fn(params, X)
+            if optimized:
+                def phi(a):
+                    # bag-multiplicity weighting only (`GBMLoss.scala:50-74`)
+                    return jnp.sum(
+                        bag_w * loss.loss(y_enc, (pred + a * direction)[:, None])
+                    )
+                alpha_opt = brent_minimize(phi, 0.0, 100.0, tol=tol, max_iter=max_iter)
+            else:
+                alpha_opt = jnp.asarray(1.0, jnp.float32)
+            weight = lr * alpha_opt
+            new_pred = pred + weight * direction
+            return params, weight, new_pred
+
+        round_step = jax.jit(round_step)
+
+        def eval_loss(pred_v, delta, y_v):
+            loss = self._make_loss(delta)
+            return jnp.mean(loss.loss(loss.encode_label(y_v), pred_v[:, None]))
+
+        eval_loss = jax.jit(eval_loss)
+
+        def huber_delta(pred, y):
+            return weighted_quantile(jnp.abs(y - pred), self.alpha)
+
+        huber_delta = jax.jit(huber_delta)
+
+        with_validation = X_val is not None
+        best = 0.0
+        pred_val = None
+        if with_validation:
+            pred_val = init_model.predict(X_val)
+            best = float(eval_loss(pred_val, delta, y_val))
+
+        members, weights = [], []
+        i, v = 0, 0
+
+        from spark_ensemble_tpu.utils.checkpoint import (
+            TrainingCheckpointer,
+            run_fingerprint,
+        )
+
+        ckpt = TrainingCheckpointer(
+            self.checkpoint_dir,
+            self.checkpoint_interval,
+            fingerprint=run_fingerprint(
+                type(self).__name__, self._resume_identity(), int(n), int(d)
+            ),
+        )
+        resumed = ckpt.load_latest()
+        if resumed is not None:
+            last_round, st = resumed
+            i, v, best = last_round + 1, int(st["v"]), float(st["best"])
+            pred = st["pred"]
+            pred_val = st.get("pred_val")
+            members = list(st["members"])
+            weights = [jnp.asarray(x) for x in st["weights"]]
+            delta = jnp.asarray(st["delta"])
+            logger.info("GBMRegressor resuming from round %d", i)
+
+        while i < self.num_base_learners and v < self.num_rounds:
+            if huber:
+                delta = huber_delta(pred, y)
+            params, weight, pred = round_step(bag_keys[i], masks[i], pred, delta, y, w)
+            members.append(params)
+            weights.append(weight)
+            if with_validation:
+                direction_val = base.predict_fn(params, X_val)
+                pred_val = pred_val + weight * direction_val
+                err = float(eval_loss(pred_val, delta, y_val))
+                best, v = self._patience_step(best, err, v, self.validation_tol)
+                logger.info("GBMRegressor round %d: val_loss=%.6f patience=%d", i, err, v)
+            ckpt.maybe_save(
+                i,
+                {
+                    "v": v,
+                    "best": best,
+                    "pred": pred,
+                    "pred_val": pred_val,
+                    "members": members,
+                    "weights": list(weights),
+                    "delta": delta,
+                },
+            )
+            i += 1
+        ckpt.delete()
+
+        keep = i - v
+        instr.log_outcome(rounds=i, kept_members=keep)
+        model_params = stack_pytrees(members[:keep]) if keep > 0 else None
+        return GBMRegressionModel(
+            params={
+                "members": model_params,
+                "weights": jnp.stack(weights[:keep]) if keep > 0 else jnp.zeros((0,)),
+                "masks": masks[:keep],
+                "init": init_model.params,
+            },
+            num_features=d,
+            init_model=init_model,
+            num_members=keep,
+            **self.get_params(),
+        )
+
+
+class GBMRegressionModel(RegressionModel, GBMRegressor):
+    """predict = init + sum_i w_i * m_i(x)  (`GBMRegressor.scala:531-539`)."""
+
+    def __init__(self, init_model=None, num_members=0, **kwargs):
+        super().__init__(**kwargs)
+        self.init_model = init_model
+        self.num_members = num_members
+
+    def predict(self, X):
+        X = as_f32(X)
+        out = self.init_model.predict(X)
+        if self.num_members == 0:
+            return out
+        base = self._base()
+        fn = self._cached_jit(
+            "predict",
+            lambda members, weights, Xq: jnp.einsum(
+                "m,mn->n",
+                weights,
+                jax.vmap(lambda p: base.predict_fn(p, Xq))(members),
+            ),
+        )
+        return out + fn(self.params["members"], self.params["weights"], X)
+
+    def take(self, k: int) -> "GBMRegressionModel":
+        """Prefix model from the first k members (test harness parity with
+        the reference's rebuilt `new GBMRegressionModel(take(i), ...)`)."""
+        k = min(k, self.num_members)
+        return GBMRegressionModel(
+            params={
+                "members": slice_pytree(self.params["members"], k),
+                "weights": self.params["weights"][:k],
+                "masks": self.params["masks"][:k],
+                "init": self.params["init"],
+            },
+            num_features=self.num_features,
+            init_model=self.init_model,
+            num_members=k,
+            **self.get_params(),
+        )
+
+
+class GBMClassifier(_GBMParams):
+    """Multiclass GBM (reference `GBMClassifier.scala`): dim regressors per
+    round (class-dim vmap), K-dim box-constrained line search, raw-score
+    prediction state."""
+
+    loss = Param("logloss", in_array(["logloss", "exponential", "bernoulli"]))
+    init_strategy = Param("prior", in_array(["prior", "uniform"]))
+
+    is_classifier = True
+
+    def _make_loss(self, num_classes):
+        return losses_mod.get_classification_loss(self.loss.lower(), num_classes)
+
+    def fit(self, X, y, sample_weight=None, validation_indicator=None):
+        X = as_f32(X)
+        y = as_f32(y)
+        w_all = resolve_weights(y, sample_weight)
+        num_classes = infer_num_classes(y)
+        if validation_indicator is not None:
+            vi = np.asarray(validation_indicator, bool)
+            X_val, y_val = X[vi], y[vi]
+            X, y, w = X[~vi], y[~vi], w_all[~vi]
+        else:
+            X_val = y_val = None
+            w = w_all
+        n, d = X.shape
+        instr = Instrumentation("GBMClassifier.fit")
+        instr.log_params(self.get_params())
+        instr.log_dataset(n, d, num_classes)
+        base = self._base()
+        ctx = base.make_fit_ctx(X)
+        bag_keys, masks = self._sampling_plan(n, d)
+        loss = self._make_loss(num_classes)
+        dim = loss.dim
+
+        # init raw scores (`GBMClassifier.scala:275-288`)
+        init_model = DummyClassifier(strategy=self.init_strategy).fit(
+            X, y, sample_weight=w
+        )
+        if dim == 1 and num_classes == 2 and self.init_strategy.lower() == "prior":
+            p1 = init_model.params["proba"][1]
+            logodds = jnp.log(p1 / jnp.maximum(1.0 - p1, 1e-30))
+            init_raw = logodds[None]
+        elif dim == 1:
+            init_raw = jnp.zeros((1,), jnp.float32)
+        else:
+            init_raw = init_model.params["raw"]
+        pred = jnp.broadcast_to(init_raw[None, :], (n, dim)).astype(jnp.float32)
+
+        updates = self.updates.lower()
+        optimized = bool(self.optimized_weights)
+        lr = float(self.learning_rate)
+        sub_ratio = float(self.subsample_ratio)
+        repl = bool(self.replacement)
+        tol = float(self.tol)
+        max_iter = int(self.max_iter)
+
+        y_enc = loss.encode_label(y)
+
+        def round_step(bag_key, mask, pred):
+            bag_w = bootstrap_weights(bag_key, n, repl, sub_ratio)
+            labels, fit_w = _pseudo_residuals_and_weights(
+                loss, updates, y_enc, pred, bag_w, w
+            )
+            # class-dim vmap replaces the reference's per-dim Futures
+            fit_j = lambda lab, fw: base.fit_from_ctx(ctx, lab, fw, mask, bag_key)
+            params = jax.vmap(fit_j, in_axes=(1, 1))(labels, fit_w)
+            directions = jax.vmap(lambda p: base.predict_fn(p, X))(params).T  # [n, dim]
+            if optimized:
+                def phi(a):
+                    return jnp.sum(bag_w * loss.loss(y_enc, pred + a[None, :] * directions))
+                alpha_opt = projected_newton_box(
+                    phi, jnp.ones((dim,), jnp.float32), max_iter=min(max_iter, 25), tol=tol
+                )
+            else:
+                alpha_opt = jnp.ones((dim,), jnp.float32)
+            weight = lr * alpha_opt
+            new_pred = pred + weight[None, :] * directions
+            return params, weight, new_pred
+
+        round_step = jax.jit(round_step)
+
+        def eval_loss(pred_v, y_enc_v):
+            return jnp.mean(loss.loss(y_enc_v, pred_v))
+
+        eval_loss = jax.jit(eval_loss)
+
+        with_validation = X_val is not None
+        best = 0.0
+        pred_val = None
+        if with_validation:
+            y_enc_val = loss.encode_label(y_val)
+            pred_val = jnp.broadcast_to(
+                init_raw[None, :], (X_val.shape[0], dim)
+            ).astype(jnp.float32)
+            best = float(eval_loss(pred_val, y_enc_val))
+
+        members, weights = [], []
+        i, v = 0, 0
+
+        from spark_ensemble_tpu.utils.checkpoint import (
+            TrainingCheckpointer,
+            run_fingerprint,
+        )
+
+        ckpt = TrainingCheckpointer(
+            self.checkpoint_dir,
+            self.checkpoint_interval,
+            fingerprint=run_fingerprint(
+                type(self).__name__,
+                self._resume_identity(),
+                int(n),
+                int(d),
+                int(num_classes),
+            ),
+        )
+        resumed = ckpt.load_latest()
+        if resumed is not None:
+            last_round, st = resumed
+            i, v, best = last_round + 1, int(st["v"]), float(st["best"])
+            pred = st["pred"]
+            pred_val = st.get("pred_val")
+            members = list(st["members"])
+            weights = [jnp.asarray(x) for x in st["weights"]]
+            logger.info("GBMClassifier resuming from round %d", i)
+
+        while i < self.num_base_learners and v < self.num_rounds:
+            params, weight, pred = round_step(bag_keys[i], masks[i], pred)
+            members.append(params)
+            weights.append(weight)
+            if with_validation:
+                dirs_val = jax.vmap(lambda p: base.predict_fn(p, X_val))(params).T
+                pred_val = pred_val + weight[None, :] * dirs_val
+                err = float(eval_loss(pred_val, y_enc_val))
+                best, v = self._patience_step(best, err, v, self.validation_tol)
+                logger.info("GBMClassifier round %d: val_loss=%.6f patience=%d", i, err, v)
+            ckpt.maybe_save(
+                i,
+                {
+                    "v": v,
+                    "best": best,
+                    "pred": pred,
+                    "pred_val": pred_val,
+                    "members": members,
+                    "weights": list(weights),
+                },
+            )
+            i += 1
+        ckpt.delete()
+
+        keep = i - v
+        instr.log_outcome(rounds=i, kept_members=keep)
+        return GBMClassificationModel(
+            params={
+                "members": stack_pytrees(members[:keep]) if keep > 0 else None,
+                "weights": jnp.stack(weights[:keep])
+                if keep > 0
+                else jnp.zeros((0, dim)),
+                "masks": masks[:keep],
+                "init_raw": init_raw,
+            },
+            num_features=d,
+            num_classes=num_classes,
+            num_members=keep,
+            dim=dim,
+            **self.get_params(),
+        )
+
+
+class GBMClassificationModel(ClassificationModel, GBMClassifier):
+    """raw = init_raw + sum_ij w_ij m_ij(x); binary dim=1 raw = (-f, f)
+    (`GBMClassifier.scala:567-589`); probabilities via the loss's
+    raw->probability mapping (`:562-565`)."""
+
+    def __init__(self, num_members=0, dim=1, **kwargs):
+        super().__init__(**kwargs)
+        self.num_members = num_members
+        self.dim = dim
+
+    def _raw_state(self, X):
+        out = jnp.broadcast_to(
+            self.params["init_raw"][None, :], (X.shape[0], self.dim)
+        ).astype(jnp.float32)
+        if self.num_members == 0:
+            return out
+        base = self._base()
+        fn = self._cached_jit(
+            "raw",
+            lambda members, weights, Xq: jnp.einsum(
+                "md,mdn->nd",
+                weights,
+                jax.vmap(jax.vmap(lambda p: base.predict_fn(p, Xq)))(members),
+            ),
+        )
+        return out + fn(self.params["members"], self.params["weights"], X)
+
+    def predict_raw(self, X):
+        X = as_f32(X)
+        f = self._raw_state(X)
+        if self.dim == 1 and self.num_classes == 2:
+            return jnp.concatenate([-f, f], axis=1)
+        return f
+
+    def predict_proba(self, X):
+        loss = self._make_loss(self.num_classes)
+        return loss.raw2probability(self.predict_raw(X))
+
+    def predict(self, X):
+        # Spark computes `prediction` from the RAW column (argmax) when
+        # rawPredictionCol is set — the evaluated behavior in every test
+        return jnp.argmax(self.predict_raw(X), axis=-1).astype(jnp.float32)
+
+    def take(self, k: int) -> "GBMClassificationModel":
+        k = min(k, self.num_members)
+        return GBMClassificationModel(
+            params={
+                "members": slice_pytree(self.params["members"], k),
+                "weights": self.params["weights"][:k],
+                "masks": self.params["masks"][:k],
+                "init_raw": self.params["init_raw"],
+            },
+            num_features=self.num_features,
+            num_classes=self.num_classes,
+            num_members=k,
+            dim=self.dim,
+            **self.get_params(),
+        )

@@ -1,0 +1,196 @@
+"""Linear base learners: ridge regression (closed form) and multinomial
+logistic regression (LBFGS).
+
+Fill the roles Spark MLlib's ``LinearRegression`` / ``LogisticRegression``
+play in the reference's stacking tests (stacker and base members,
+`StackingClassifierSuite.scala`, `StackingRegressorSuite.scala`).  Both are
+pure-functional members of the BaseLearner protocol:
+
+- LinearRegression solves the weighted normal equations
+  ``(X'WX + reg·I) beta = X'Wy`` with a Cholesky solve — one MXU-friendly
+  matmul pair, no iterative loop.
+- LogisticRegression minimizes weighted multinomial cross-entropy with
+  ``optax.lbfgs`` inside a ``lax.while_loop`` (the JAX analogue of breeze
+  LBFGS that Spark uses underneath).
+
+Feature subspace masks multiply into X at fit *and* predict (params carry the
+mask), matching the reference's slice-projection semantics
+(`HasSubBag.scala:81-84`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from spark_ensemble_tpu.models.base import (
+    BaseLearner,
+    ClassificationModel,
+    RegressionModel,
+    as_f32,
+)
+from spark_ensemble_tpu.params import Param, gt_eq
+
+
+def _apply_mask(X, feature_mask):
+    if feature_mask is None:
+        return X
+    return X * feature_mask.astype(X.dtype)[None, :]
+
+
+def _feature_stats(X, w):
+    """Weighted per-feature mean and std (std floored; constant/masked
+    columns get sd=1 so they contribute nothing and stay solvable)."""
+    wsum = jnp.maximum(jnp.sum(w), 1e-30)
+    mu = jnp.sum(w[:, None] * X, axis=0) / wsum
+    var = jnp.sum(w[:, None] * (X - mu[None, :]) ** 2, axis=0) / wsum
+    sd = jnp.sqrt(var)
+    sd = jnp.where(sd > 1e-7 * (1.0 + jnp.abs(mu)), sd, 1.0)
+    return mu, sd
+
+
+class LinearRegression(BaseLearner):
+    reg_param = Param(1e-6, gt_eq(0.0))
+    fit_intercept = Param(True)
+
+    is_classifier = False
+
+    def fit_from_ctx(self, ctx, y, w, feature_mask, key):
+        X = _apply_mask(ctx, feature_mask)
+        n, d = X.shape
+        # standardize features (Spark LinearRegression standardizes
+        # internally too); essential for f32 normal equations on raw-scale
+        # data like cpusmall (feature magnitudes up to ~1e6)
+        mu, sd = _feature_stats(X, w)
+        Xs = (X - mu[None, :]) / sd[None, :]
+        if self.fit_intercept:
+            Xs = jnp.concatenate([Xs, jnp.ones((n, 1), X.dtype)], axis=1)
+        Xw = Xs * w[:, None]
+        A = Xs.T @ Xw + (self.reg_param + 1e-6) * jnp.eye(Xs.shape[1], dtype=X.dtype)
+        b = Xw.T @ y
+        beta = jax.scipy.linalg.solve(A, b, assume_a="pos")
+        coef_s = beta[:d] if self.fit_intercept else beta
+        icpt_s = beta[d] if self.fit_intercept else jnp.asarray(0.0, X.dtype)
+        coef = coef_s / sd
+        intercept = icpt_s - jnp.sum(coef * mu)
+        mask = (
+            feature_mask.astype(jnp.float32)
+            if feature_mask is not None
+            else jnp.ones((d,), jnp.float32)
+        )
+        return {"coef": coef, "intercept": intercept, "mask": mask}
+
+    def predict_fn(self, params, X):
+        return (X * params["mask"][None, :]) @ params["coef"] + params["intercept"]
+
+    def model_from_params(self, params, num_features, num_classes=None):
+        return LinearRegressionModel(
+            params=params, num_features=num_features, **self.get_params()
+        )
+
+
+class LinearRegressionModel(RegressionModel, LinearRegression):
+    def predict(self, X):
+        return self.predict_fn(self.params, as_f32(X))
+
+
+def _lbfgs_minimize(fun, init_params, max_iter: int, tol: float):
+    """Run optax LBFGS to convergence inside a ``lax.while_loop``."""
+    opt = optax.lbfgs()
+    value_and_grad = optax.value_and_grad_from_state(fun)
+
+    def step(carry):
+        params, state = carry
+        value, grad = value_and_grad(params, state=state)
+        updates, state = opt.update(
+            grad, state, params, value=value, grad=grad, value_fn=fun
+        )
+        params = optax.apply_updates(params, updates)
+        return params, state
+
+    def cont(carry):
+        _, state = carry
+        i = optax.tree_utils.tree_get(state, "count")
+        grad = optax.tree_utils.tree_get(state, "grad")
+        err = optax.tree_utils.tree_norm(grad)
+        return (i == 0) | ((i < max_iter) & (err >= tol))
+
+    init_state = opt.init(init_params)
+    params, _ = jax.lax.while_loop(cont, step, (init_params, init_state))
+    return params
+
+
+class LogisticRegression(BaseLearner):
+    reg_param = Param(1e-6, gt_eq(0.0), doc="L2 penalty")
+    fit_intercept = Param(True)
+    max_iter = Param(100, gt_eq(1))
+    tol = Param(1e-6, gt_eq(0.0))
+
+    is_classifier = True
+
+    def make_fit_ctx(self, X, num_classes=None):
+        return {"X": as_f32(X), "num_classes": num_classes}
+
+    def fit_from_ctx(self, ctx, y, w, feature_mask, key):
+        X = _apply_mask(ctx["X"], feature_mask)
+        k = ctx["num_classes"]
+        n, d = X.shape
+        mu, sd = _feature_stats(X, w)
+        Xs = (X - mu[None, :]) / sd[None, :]
+        onehot = jax.nn.one_hot(y.astype(jnp.int32), k)
+        w_norm = w / jnp.maximum(jnp.sum(w), 1e-30)
+
+        def objective(theta):
+            logits = Xs @ theta["coef"] + theta["intercept"][None, :]
+            ce = -jnp.sum(onehot * jax.nn.log_softmax(logits, axis=-1), axis=-1)
+            reg = 0.5 * self.reg_param * jnp.sum(theta["coef"] ** 2)
+            return jnp.sum(w_norm * ce) + reg
+
+        init = {
+            "coef": jnp.zeros((d, k), jnp.float32),
+            "intercept": jnp.zeros((k,), jnp.float32),
+        }
+        theta = _lbfgs_minimize(objective, init, self.max_iter, self.tol)
+        coef = theta["coef"] / sd[:, None]
+        intercept = theta["intercept"] - (mu / sd) @ theta["coef"]
+        if not self.fit_intercept:
+            intercept = jnp.zeros((k,), jnp.float32)
+        mask = (
+            feature_mask.astype(jnp.float32)
+            if feature_mask is not None
+            else jnp.ones((d,), jnp.float32)
+        )
+        return {"coef": coef, "intercept": intercept, "mask": mask}
+
+    def predict_raw_fn(self, params, X):
+        return (X * params["mask"][None, :]) @ params["coef"] + params["intercept"][
+            None, :
+        ]
+
+    def predict_proba_fn(self, params, X):
+        return jax.nn.softmax(self.predict_raw_fn(params, X), axis=-1)
+
+    def predict_fn(self, params, X):
+        return jnp.argmax(self.predict_raw_fn(params, X), axis=-1).astype(jnp.float32)
+
+    def model_from_params(self, params, num_features, num_classes=None):
+        return LogisticRegressionModel(
+            params=params,
+            num_features=num_features,
+            num_classes=num_classes or 2,
+            **self.get_params(),
+        )
+
+
+class LogisticRegressionModel(ClassificationModel, LogisticRegression):
+    def predict_proba(self, X):
+        return self.predict_proba_fn(self.params, as_f32(X))
+
+    def predict_raw(self, X):
+        return self.predict_raw_fn(self.params, as_f32(X))
+
+    def predict(self, X):
+        return self.predict_fn(self.params, as_f32(X))

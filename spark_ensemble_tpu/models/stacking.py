@@ -1,0 +1,165 @@
+"""Stacked generalization (reference `StackingRegressor.scala`,
+`StackingClassifier.scala`).
+
+Heterogeneous base learners are fitted as separate jit programs (a Python
+loop — the analogue of the reference's parallel driver Futures at
+`StackingClassifier.scala:174-186`; each fit is itself a fully-compiled XLA
+program, and XLA overlaps dispatch).  Meta-features are assembled on device:
+
+- regression: the vector of base predictions (`StackingRegressor.scala:155-163`)
+- classification, by ``stack_method`` (`StackingClassifier.scala:60-74,190-202`):
+  ``class`` -> member predicted class (1 column per member),
+  ``raw`` -> member raw scores (K columns per member),
+  ``proba`` -> member probabilities (K columns per member).
+
+The stacker (meta-learner) trains on the meta-feature matrix; prediction
+routes a fresh meta-feature row through the stacker
+(`StackingClassifier.scala:260-270`).  Base learners that don't support
+sample weights get them dropped with a warning
+(`StackingClassifier.scala:147-150`).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from spark_ensemble_tpu.models.base import (
+    BaseLearner,
+    ClassificationModel,
+    Estimator,
+    Model,
+    RegressionModel,
+    as_f32,
+    infer_num_classes,
+    resolve_weights,
+)
+from spark_ensemble_tpu.models.linear import LinearRegression, LogisticRegression
+from spark_ensemble_tpu.models.tree import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+)
+from spark_ensemble_tpu.params import Param, in_array
+
+logger = logging.getLogger(__name__)
+
+
+class _StackingParams(Estimator):
+    """Reference `StackingParams.scala:22-27`."""
+
+    base_learners = Param(None, is_estimator=True)
+    stacker = Param(None, is_estimator=True)
+    parallelism = Param(1, doc="API parity; fits are dispatched back-to-back")
+    seed = Param(0)
+
+
+class StackingRegressor(_StackingParams):
+    is_classifier = False
+
+    def _bases(self) -> List[BaseLearner]:
+        return list(self.base_learners or [DecisionTreeRegressor(), LinearRegression()])
+
+    def _stacker(self) -> BaseLearner:
+        return self.stacker or LinearRegression()
+
+    def fit(self, X, y, sample_weight=None) -> "StackingRegressionModel":
+        X, y = as_f32(X), as_f32(y)
+        w = resolve_weights(y, sample_weight)
+        models = []
+        for i, base in enumerate(self._bases()):
+            sw = w if base.supports_weight else None
+            if not base.supports_weight and sample_weight is not None:
+                logger.warning(
+                    "base learner %s does not support weights; ignoring",
+                    type(base).__name__,
+                )
+            models.append(base.fit(X, y, sample_weight=sw))
+        meta = jnp.stack([m.predict(X) for m in models], axis=1)  # [n, num_bases]
+        stack_model = self._stacker().fit(meta, y, sample_weight=w)
+        return StackingRegressionModel(
+            base_models=models,
+            stack_model=stack_model,
+            num_features=X.shape[1],
+            **self.get_params(),
+        )
+
+
+class StackingRegressionModel(RegressionModel, StackingRegressor):
+    def __init__(self, base_models=None, stack_model=None, **kwargs):
+        super().__init__(**kwargs)
+        self.base_models = base_models or []
+        self.stack_model = stack_model
+
+    def predict(self, X):
+        X = as_f32(X)
+        meta = jnp.stack([m.predict(X) for m in self.base_models], axis=1)
+        return self.stack_model.predict(meta)
+
+
+class StackingClassifier(_StackingParams):
+    stack_method = Param("class", in_array(["class", "raw", "proba"]))
+
+    is_classifier = True
+
+    def _bases(self) -> List[BaseLearner]:
+        return list(
+            self.base_learners or [DecisionTreeClassifier(), LogisticRegression()]
+        )
+
+    def _stacker(self) -> BaseLearner:
+        return self.stacker or LogisticRegression()
+
+    def _meta_features(self, models: List[Model], X) -> jax.Array:
+        method = self.stack_method.lower()
+        cols = []
+        for m in models:
+            if method == "raw":
+                cols.append(m.predict_raw(X))
+            elif method == "proba":
+                cols.append(m.predict_proba(X))
+            else:
+                cols.append(m.predict(X)[:, None])
+        return jnp.concatenate(cols, axis=1)
+
+    def fit(self, X, y, sample_weight=None) -> "StackingClassificationModel":
+        X, y = as_f32(X), as_f32(y)
+        w = resolve_weights(y, sample_weight)
+        models = []
+        for base in self._bases():
+            sw = w if base.supports_weight else None
+            if not base.supports_weight and sample_weight is not None:
+                logger.warning(
+                    "base learner %s does not support weights; ignoring",
+                    type(base).__name__,
+                )
+            models.append(base.fit(X, y, sample_weight=sw))
+        meta = self._meta_features(models, X)
+        stack_model = self._stacker().fit(meta, y, sample_weight=w)
+        return StackingClassificationModel(
+            base_models=models,
+            stack_model=stack_model,
+            num_features=X.shape[1],
+            num_classes=infer_num_classes(y),
+            **self.get_params(),
+        )
+
+
+class StackingClassificationModel(ClassificationModel, StackingClassifier):
+    def __init__(self, base_models=None, stack_model=None, **kwargs):
+        super().__init__(**kwargs)
+        self.base_models = base_models or []
+        self.stack_model = stack_model
+
+    def predict_raw(self, X):
+        meta = self._meta_features(self.base_models, as_f32(X))
+        return self.stack_model.predict_raw(meta)
+
+    def predict_proba(self, X):
+        meta = self._meta_features(self.base_models, as_f32(X))
+        return self.stack_model.predict_proba(meta)
+
+    def predict(self, X):
+        return jnp.argmax(self.predict_raw(X), axis=-1).astype(jnp.float32)

@@ -1,0 +1,362 @@
+"""GBM loss layer: pure, batched, differentiable loss functions.
+
+Re-designs the reference's loss subsystem (`GBMLoss.scala:78-318`) as pure
+JAX functions over batched arrays.  Where the reference hand-writes per-row
+scalar loops for loss/gradient/hessian and reduces them through Spark's
+``DifferentiableLossAggregator`` (`GBMLoss.scala:34-76`), here every loss is
+an elementwise kernel on ``(label[n, k], prediction[n, k])`` arrays whose
+gradient/hessian are closed-form (matching the reference's formulas exactly,
+e.g. the Huber/Quantile subgradients) and whose aggregate objective is a
+single jitted ``value_and_grad`` with a ``psum`` across data shards.
+
+Loss inventory and semantics mirror the reference:
+- regression (dim=1, identity label encoding): squared (`:129-137`),
+  absolute (`:139-143`), logcosh (`:145-152`), scaled logcosh(alpha)
+  (`:154-166`), huber(delta) (`:168-177`), quantile(q) (`:179-188`)
+- classification: logloss(K) softmax cross-entropy (`:196-263`),
+  exponential (`:265-291`), bernoulli (`:293-318`) — the latter two use
+  {0,1} -> {-1,+1} label encoding and dim=1.
+
+Losses without a reference hessian (absolute, huber, quantile) report
+``has_hessian=False``; GBM's "newton" update is only valid for the others,
+mirroring ``HasHessian`` (`GBMLoss.scala:96-105`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _logcosh(x):
+    # log(cosh(x)) computed stably: |x| + log1p(exp(-2|x|)) - log(2)
+    a = jnp.abs(x)
+    return a + jnp.log1p(jnp.exp(-2.0 * a)) - jnp.log(2.0)
+
+
+def _log1pexp(x):
+    # log(1 + exp(x)) stably (reference: spark ml impl Utils.log1pExp)
+    return jnp.logaddexp(0.0, x)
+
+
+class GBMLoss:
+    """Protocol: batched loss over ``label[n, dim]`` / ``prediction[n, dim]``.
+
+    ``loss`` returns per-instance values ``[n]``; ``gradient`` and ``hessian``
+    return ``[n, dim]``.  All methods are traceable (jit/vmap/grad-safe).
+    """
+
+    dim: int = 1
+    has_hessian: bool = False
+    name: str = ""
+
+    def encode_label(self, y: jax.Array) -> jax.Array:
+        """``y[n] -> encoded[n, dim]`` (reference ``encodeLabel``)."""
+        return y[:, None]
+
+    def loss(self, label: jax.Array, prediction: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def gradient(self, label: jax.Array, prediction: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def negative_gradient(self, label, prediction):
+        return -self.gradient(label, prediction)
+
+    def hessian(self, label: jax.Array, prediction: jax.Array) -> jax.Array:
+        raise NotImplementedError(f"{self.name} has no hessian")
+
+    # serialization hooks (see utils.persist)
+    def config(self) -> dict:
+        return {"name": self.name}
+
+
+class GBMClassificationLoss(GBMLoss):
+    """Adds raw-score -> class-probability mapping (reference `:190-194`)."""
+
+    num_classes: int = 2
+
+    def raw2probability(self, raw: jax.Array) -> jax.Array:
+        """``raw[n, num_classes] -> proba[n, num_classes]``."""
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Regression losses
+# ---------------------------------------------------------------------------
+
+
+class SquaredLoss(GBMLoss):
+    name = "squared"
+    has_hessian = True
+
+    def loss(self, label, prediction):
+        return jnp.sum((label - prediction) ** 2 / 2.0, axis=-1)
+
+    def gradient(self, label, prediction):
+        return -(label - prediction)
+
+    def hessian(self, label, prediction):
+        return jnp.ones_like(prediction)
+
+
+class AbsoluteLoss(GBMLoss):
+    name = "absolute"
+
+    def loss(self, label, prediction):
+        return jnp.sum(jnp.abs(label - prediction), axis=-1)
+
+    def gradient(self, label, prediction):
+        return -jnp.sign(label - prediction)
+
+
+class LogCoshLoss(GBMLoss):
+    name = "logcosh"
+    has_hessian = True
+
+    def loss(self, label, prediction):
+        return jnp.sum(_logcosh(label - prediction), axis=-1)
+
+    def gradient(self, label, prediction):
+        return -jnp.tanh(label - prediction)
+
+    def hessian(self, label, prediction):
+        t = jnp.tanh(label - prediction)
+        return 1.0 - t * t
+
+
+class ScaledLogCoshLoss(GBMLoss):
+    """Asymmetric logcosh: alpha above the prediction, (1-alpha) below
+    (reference `GBMLoss.scala:154-166`)."""
+
+    name = "scaledlogcosh"
+    has_hessian = True
+
+    def __init__(self, alpha: float = 0.5):
+        self.alpha = alpha
+
+    def _scale(self, label, prediction):
+        return jnp.where(label > prediction, self.alpha, 1.0 - self.alpha)
+
+    def loss(self, label, prediction):
+        return jnp.sum(
+            self._scale(label, prediction) * _logcosh(label - prediction), axis=-1
+        )
+
+    def gradient(self, label, prediction):
+        return self._scale(label, prediction) * -jnp.tanh(label - prediction)
+
+    def hessian(self, label, prediction):
+        t = jnp.tanh(label - prediction)
+        return self._scale(label, prediction) * (1.0 - t * t)
+
+    def config(self):
+        return {"name": self.name, "alpha": self.alpha}
+
+
+class HuberLoss(GBMLoss):
+    name = "huber"
+
+    def __init__(self, delta: float = 1.0):
+        self.delta = delta
+
+    def loss(self, label, prediction):
+        r = label - prediction
+        quad = r * r / 2.0
+        lin = self.delta * (jnp.abs(r) - self.delta / 2.0)
+        return jnp.sum(jnp.where(jnp.abs(r) <= self.delta, quad, lin), axis=-1)
+
+    def gradient(self, label, prediction):
+        r = label - prediction
+        return jnp.where(jnp.abs(r) <= self.delta, -r, -self.delta * jnp.sign(r))
+
+    def config(self):
+        return {"name": self.name, "delta": self.delta}
+
+
+class QuantileLoss(GBMLoss):
+    name = "quantile"
+
+    def __init__(self, quantile: float = 0.5):
+        self.quantile = quantile
+
+    def loss(self, label, prediction):
+        r = label - prediction
+        return jnp.sum(
+            jnp.where(r > 0, self.quantile * r, (self.quantile - 1.0) * r), axis=-1
+        )
+
+    def gradient(self, label, prediction):
+        r = label - prediction
+        return jnp.where(r > 0, -self.quantile, 1.0 - self.quantile)
+
+    def config(self):
+        return {"name": self.name, "quantile": self.quantile}
+
+
+# ---------------------------------------------------------------------------
+# Classification losses
+# ---------------------------------------------------------------------------
+
+
+class LogLoss(GBMClassificationLoss):
+    """K-class softmax cross-entropy on one-hot labels (`GBMLoss.scala:196-263`)."""
+
+    name = "logloss"
+    has_hessian = True
+
+    def __init__(self, num_classes: int):
+        self.num_classes = num_classes
+        self.dim = num_classes
+
+    def encode_label(self, y):
+        return jax.nn.one_hot(y.astype(jnp.int32), self.num_classes)
+
+    def loss(self, label, prediction):
+        logsumexp = jax.scipy.special.logsumexp(prediction, axis=-1, keepdims=True)
+        return jnp.sum(-label * (prediction - logsumexp), axis=-1)
+
+    def gradient(self, label, prediction):
+        return jax.nn.softmax(prediction, axis=-1) - label
+
+    def hessian(self, label, prediction):
+        p = jax.nn.softmax(prediction, axis=-1)
+        return p * (1.0 - p)
+
+    def raw2probability(self, raw):
+        return jax.nn.softmax(raw, axis=-1)
+
+    def config(self):
+        return {"name": self.name, "num_classes": self.num_classes}
+
+
+class ExponentialLoss(GBMClassificationLoss):
+    """AdaBoost exponential loss on {-1,+1}-encoded labels (`GBMLoss.scala:265-291`)."""
+
+    name = "exponential"
+    has_hessian = True
+    num_classes = 2
+
+    def encode_label(self, y):
+        return (2.0 * y - 1.0)[:, None]
+
+    def loss(self, label, prediction):
+        return jnp.sum(jnp.exp(-label * prediction), axis=-1)
+
+    def gradient(self, label, prediction):
+        return -label * jnp.exp(-label * prediction)
+
+    def hessian(self, label, prediction):
+        return label * label * jnp.exp(-label * prediction)
+
+    def raw2probability(self, raw):
+        # reference: proba(1) = sigmoid(2 * raw(0)) with raw = (-f, f),
+        # i.e. P(y=1) = sigmoid(-2 f) as composed by GBMClassificationModel
+        # (`GBMClassifier.scala:562-565,583-587`); we preserve the composed
+        # behavior on the K=2 raw vector.
+        p1 = jax.nn.sigmoid(2.0 * raw[..., 0])
+        return jnp.stack([1.0 - p1, p1], axis=-1)
+
+
+class BernoulliLoss(GBMClassificationLoss):
+    """Logistic loss on {-1,+1}-encoded labels (`GBMLoss.scala:293-318`)."""
+
+    name = "bernoulli"
+    has_hessian = True
+    num_classes = 2
+
+    def encode_label(self, y):
+        return (2.0 * y - 1.0)[:, None]
+
+    def loss(self, label, prediction):
+        return jnp.sum(_log1pexp(-2.0 * label * prediction), axis=-1)
+
+    def gradient(self, label, prediction):
+        return -2.0 * label / (1.0 + jnp.exp(2.0 * label * prediction))
+
+    def hessian(self, label, prediction):
+        e = jnp.exp(2.0 * prediction * label)
+        return (4.0 * e * label * label) / (1.0 + e) ** 2
+
+    def raw2probability(self, raw):
+        # reference: proba(1) = 1 / (1 + exp(raw(0))) with raw = (-f, f),
+        # i.e. P(y=1) = sigmoid(f) (`GBMLoss.scala:311-316`).
+        p1 = jax.nn.sigmoid(-raw[..., 0])
+        return jnp.stack([1.0 - p1, p1], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+def get_regression_loss(
+    name: str,
+    alpha: float = 0.5,
+    delta: float = 1.0,
+    quantile: float = 0.5,
+) -> GBMLoss:
+    """By-name lookup mirroring ``GBMRegressor.getLoss`` (case-insensitive)."""
+    name = name.lower()
+    if name == "squared":
+        return SquaredLoss()
+    if name == "absolute":
+        return AbsoluteLoss()
+    if name == "logcosh":
+        return LogCoshLoss()
+    if name == "scaledlogcosh":
+        return ScaledLogCoshLoss(alpha)
+    if name == "huber":
+        return HuberLoss(delta)
+    if name == "quantile":
+        return QuantileLoss(quantile)
+    raise ValueError(f"unknown regression loss {name!r}")
+
+
+def get_classification_loss(name: str, num_classes: int = 2) -> GBMClassificationLoss:
+    """By-name lookup mirroring ``GBMClassifier.getLoss``."""
+    name = name.lower()
+    if name == "logloss":
+        return LogLoss(num_classes)
+    if name == "exponential":
+        return ExponentialLoss()
+    if name == "bernoulli":
+        return BernoulliLoss()
+    raise ValueError(f"unknown classification loss {name!r}")
+
+
+def loss_from_config(cfg: dict) -> GBMLoss:
+    name = cfg["name"]
+    if name == "logloss":
+        return LogLoss(cfg["num_classes"])
+    if name in ("exponential", "bernoulli"):
+        return get_classification_loss(name)
+    return get_regression_loss(
+        name,
+        alpha=cfg.get("alpha", 0.5),
+        delta=cfg.get("delta", 1.0),
+        quantile=cfg.get("quantile", 0.5),
+    )
+
+
+def aggregate_loss(
+    loss: GBMLoss,
+    label: jax.Array,
+    weight: jax.Array,
+    prediction: jax.Array,
+    axis_name: Optional[str] = None,
+) -> jax.Array:
+    """Weighted-mean objective with optional cross-shard ``psum``.
+
+    The SPMD replacement for ``GBMLossAggregator`` + ``RDDLossFunction``
+    (`GBMLoss.scala:34-76`): every shard computes its weighted loss sum, a
+    ``psum`` over the mesh data axis produces the identical global mean on
+    all devices.
+    """
+    num = jnp.sum(weight * loss.loss(label, prediction))
+    den = jnp.sum(weight)
+    if axis_name is not None:
+        num = jax.lax.psum(num, axis_name)
+        den = jax.lax.psum(den, axis_name)
+    return num / jnp.maximum(den, 1e-30)

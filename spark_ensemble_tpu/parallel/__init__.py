@@ -1,0 +1,3 @@
+from spark_ensemble_tpu.parallel.mesh import create_mesh, data_member_mesh
+
+__all__ = ["create_mesh", "data_member_mesh"]

@@ -1,0 +1,70 @@
+"""Device-mesh construction for the framework's two parallel axes.
+
+The reference's parallelism axes (SURVEY.md §2.5) are:
+- **rows** (data parallelism): RDD partitions over Spark executors, reduced
+  with ``treeReduce``/``treeAggregate``;
+- **ensemble members / class dims** (task parallelism): driver thread-pool
+  Futures (`BaggingClassifier.scala:180-201`, `GBMClassifier.scala:377-411`).
+
+The TPU-native mapping is a 2-D ``jax.sharding.Mesh`` with axes
+``("data", "member")``: rows sharded over ``data`` (reductions become
+``psum`` over ICI), members/class-dims sharded over ``member``.  On
+multi-slice pods, put ``data`` on the DCN-spanning axis (gradient-style
+psums tolerate DCN latency) and ``member`` within a slice.  The reference
+has no sequence dimension, so there is no sequence/context-parallel axis —
+rows x members IS the scaling surface (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def create_mesh(axis_sizes: dict, devices: Optional[Sequence] = None) -> Mesh:
+    """Mesh from ``{axis_name: size}``; sizes must multiply to #devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    shape = tuple(axis_sizes.values())
+    total = int(np.prod(shape))
+    if total != len(devices):
+        raise ValueError(
+            f"mesh {axis_sizes} needs {total} devices, have {len(devices)}"
+        )
+    arr = np.array(devices).reshape(shape)
+    return Mesh(arr, tuple(axis_sizes.keys()))
+
+
+def data_member_mesh(
+    n_devices: Optional[int] = None, member: int = 1
+) -> Mesh:
+    """The standard ("data", "member") mesh; ``member`` divides n_devices."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if n % member != 0:
+        raise ValueError(f"member axis {member} must divide device count {n}")
+    return create_mesh(
+        {"data": n // member, "member": member}, devices=devices[:n]
+    )
+
+
+def data_sharding(mesh: Mesh, *batch_axis_first: int) -> NamedSharding:
+    """Rows-on-data sharding for an array whose axis 0 is the row axis."""
+    return NamedSharding(mesh, PartitionSpec("data"))
+
+
+def pad_to_multiple(x, multiple: int, axis: int = 0, fill=0.0):
+    """Pad the row axis so it divides the data-axis size.  Padding rows get
+    weight 0 downstream, so statistics are unchanged (weight-mask sampling
+    makes padding free — SURVEY.md §2.5 row-sampling note)."""
+    import jax.numpy as jnp
+
+    n = x.shape[axis]
+    rem = (-n) % multiple
+    if rem == 0:
+        return x, n
+    pad_width = [(0, 0)] * x.ndim
+    pad_width[axis] = (0, rem)
+    return jnp.pad(x, pad_width, constant_values=fill), n

@@ -1,0 +1,6 @@
+from spark_ensemble_tpu.utils.quantile import (
+    weighted_median,
+    weighted_quantile,
+)
+
+__all__ = ["weighted_median", "weighted_quantile"]

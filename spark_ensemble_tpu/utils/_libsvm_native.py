@@ -1,0 +1,87 @@
+"""ctypes binding for the native libsvm parser (csrc/libsvm_parser.cpp).
+
+Compiled on demand with the system toolchain into the package build dir;
+callers fall back to the pure-numpy parser on any failure (missing compiler,
+read-only filesystem, ...).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LIB = None
+
+
+def _source_path() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(here, "csrc", "libsvm_parser.cpp")
+
+
+def _build_lib() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    src = _source_path()
+    if not os.path.exists(src):
+        raise ImportError("csrc/libsvm_parser.cpp not found")
+    # build artifact lives next to the source tree (user-owned), never in a
+    # shared world-writable location; fall back to a fresh private tempdir
+    cache_dir = os.path.join(os.path.dirname(os.path.dirname(src)), "build", "native")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        cache_dir = tempfile.mkdtemp(prefix="se_tpu_native_")
+    so_path = os.path.join(cache_dir, "libsvm_parser.so")
+    if not os.path.exists(so_path) or os.path.getmtime(so_path) < os.path.getmtime(src):
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", so_path, src],
+            check=True,
+            capture_output=True,
+        )
+    lib = ctypes.CDLL(so_path)
+    lib.libsvm_scan.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_long),
+        ctypes.POINTER(ctypes.c_long),
+    ]
+    lib.libsvm_scan.restype = ctypes.c_int
+    lib.libsvm_fill.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_long,
+        ctypes.c_long,
+    ]
+    lib.libsvm_fill.restype = ctypes.c_int
+    _LIB = lib
+    return lib
+
+
+def parse_libsvm_native(
+    path: str, n_features: Optional[int] = None
+) -> Tuple[np.ndarray, np.ndarray]:
+    lib = _build_lib()
+    n_rows = ctypes.c_long()
+    max_idx = ctypes.c_long()
+    if lib.libsvm_scan(path.encode(), ctypes.byref(n_rows), ctypes.byref(max_idx)):
+        raise IOError(f"native scan failed for {path}")
+    n = n_rows.value
+    d = n_features if n_features is not None else max_idx.value
+    X = np.zeros((n, d), dtype=np.float32)
+    y = np.zeros((n,), dtype=np.float32)
+    rc = lib.libsvm_fill(
+        path.encode(),
+        X.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        y.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n,
+        d,
+    )
+    if rc:
+        raise IOError(f"native fill failed for {path} (rc={rc})")
+    return X, y

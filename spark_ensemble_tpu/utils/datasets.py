@@ -1,0 +1,117 @@
+"""Dataset loading: libsvm parsing and dense array containers.
+
+The reference's substrate is Spark's DataFrame/libsvm reader; ours is a dense
+``(X: f32[n, d], y: f32[n])`` pair of host numpy arrays that estimators move
+to device.  The three datasets bundled with the reference
+(`/root/reference/data/{adult,cpusmall,letter}`) are read in place — they are
+data, not code, and are never copied into this repo.
+
+A native C++ fast path for parsing (the analogue of Spark's JVM loader) is
+used when the compiled extension is present; the numpy fallback is always
+available.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+REFERENCE_DATA = os.environ.get(
+    "SPARK_ENSEMBLE_REFERENCE_DATA", "/root/reference/data"
+)
+
+_DATASETS = {
+    "adult": ("adult/adult.svm", "binary"),
+    "cpusmall": ("cpusmall/cpusmall.svm", "regression"),
+    "letter": ("letter/letter.svm", "multiclass"),
+}
+
+
+def parse_libsvm(
+    path: str, n_features: Optional[int] = None
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Parse a libsvm text file into dense ``(X, y)`` float32/float64 arrays.
+
+    Mirrors the semantics of Spark's ``format("libsvm")`` reader used
+    throughout the reference test suites (1-based feature indices).
+    """
+    try:
+        from spark_ensemble_tpu.utils._libsvm_native import parse_libsvm_native
+
+        return parse_libsvm_native(path, n_features)
+    except Exception:
+        pass
+    labels = []
+    rows = []
+    max_idx = 0
+    with open(path, "r") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            feats = []
+            for tok in parts[1:]:
+                idx, val = tok.split(":")
+                idx = int(idx)
+                max_idx = max(max_idx, idx)
+                feats.append((idx - 1, float(val)))
+            rows.append(feats)
+    d = n_features if n_features is not None else max_idx
+    X = np.zeros((len(rows), d), dtype=np.float32)
+    for i, feats in enumerate(rows):
+        for j, v in feats:
+            if j < d:  # out-of-range features dropped (native path parity)
+                X[i, j] = v
+    y = np.asarray(labels, dtype=np.float32)
+    return X, y
+
+
+def load_dataset(
+    name: str, data_dir: Optional[str] = None
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Load one of the reference's bundled datasets by name.
+
+    Labels are normalized the way the reference tests consume them:
+    - adult: ±1 → {0, 1}
+    - letter: 1..26 → 0..25
+    - cpusmall: raw regression target
+    """
+    if name not in _DATASETS:
+        raise KeyError(f"unknown dataset {name!r}; options: {sorted(_DATASETS)}")
+    rel, kind = _DATASETS[name]
+    base = data_dir or REFERENCE_DATA
+    path = os.path.join(base, rel)
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    X, y = parse_libsvm(path)
+    if kind == "binary":
+        y = (y > 0).astype(np.float32)
+    elif kind == "multiclass":
+        y = (y - y.min()).astype(np.float32)
+    return X, y
+
+
+def has_reference_data() -> bool:
+    return all(
+        os.path.exists(os.path.join(REFERENCE_DATA, rel))
+        for rel, _ in _DATASETS.values()
+    )
+
+
+def train_test_split(
+    X: np.ndarray,
+    y: np.ndarray,
+    test_fraction: float = 0.3,
+    seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Deterministic random split (reference tests: ``df.randomSplit(Array(0.7, 0.3))``)."""
+    rng = np.random.RandomState(seed)
+    n = X.shape[0]
+    perm = rng.permutation(n)
+    n_test = int(round(n * test_fraction))
+    test_idx, train_idx = perm[:n_test], perm[n_test:]
+    return X[train_idx], y[train_idx], X[test_idx], y[test_idx]

@@ -1,0 +1,68 @@
+"""Training instrumentation: structured logging + profiler hooks.
+
+The reference wraps every ``train`` in Spark ML ``Instrumentation``
+(``instrumented { instr => ... }``) logging pipeline stage, dataset, params,
+numClasses and per-iteration values (`BaggingRegressor.scala:117-131`,
+`BoostingClassifier.scala:182`, SURVEY.md §5).  This module provides the
+equivalent: an ``instrumented`` context manager that logs estimator params
+on entry and outcome on exit, per-round ``log_named_value``, and an optional
+``jax.profiler`` trace context for TPU timeline capture (the reference has
+no profiler integration; tests used ``spark.time`` wall-clock prints).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Any, Dict, Iterator, Optional
+
+logger = logging.getLogger("spark_ensemble_tpu")
+
+
+class Instrumentation:
+    def __init__(self, stage: str):
+        self.stage = stage
+        self.t0 = time.perf_counter()
+
+    def log_params(self, params: Dict[str, Any]) -> None:
+        clean = {
+            k: v for k, v in params.items() if isinstance(v, (bool, int, float, str))
+        }
+        logger.info("[%s] params: %s", self.stage, clean)
+
+    def log_dataset(self, n: int, d: int, num_classes: Optional[int] = None) -> None:
+        extra = f", numClasses={num_classes}" if num_classes is not None else ""
+        logger.info("[%s] dataset: n=%d, d=%d%s", self.stage, n, d, extra)
+
+    def log_named_value(self, name: str, value) -> None:
+        logger.info("[%s] %s=%s", self.stage, name, value)
+
+    def log_outcome(self, **kv) -> None:
+        elapsed = time.perf_counter() - self.t0
+        logger.info("[%s] done in %.3fs: %s", self.stage, elapsed, kv)
+
+
+@contextlib.contextmanager
+def instrumented(stage: str) -> Iterator[Instrumentation]:
+    """``with instrumented("GBMRegressor.fit") as instr:`` — the analogue of
+    the reference's ``instrumented { instr => ... }`` wrapper."""
+    instr = Instrumentation(stage)
+    try:
+        yield instr
+    except Exception:
+        logger.exception("[%s] failed", stage)
+        raise
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture a ``jax.profiler`` trace (TensorBoard-viewable) around a
+    training run when ``log_dir`` is set; no-op otherwise."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

@@ -1,0 +1,209 @@
+"""Model/estimator persistence: JSON metadata + npz array payloads.
+
+Mirrors the reference's persistence *semantics* (§2.6 of SURVEY.md): params
+are saved as JSON metadata with estimator-valued params excluded and written
+as nested directories (`learner/`, `learner-$i/`, `stacker/`,
+`model-$i/` — reference `ensembleParams.scala:85-194`,
+`BaggingRegressor.scala:178-291`), learned arrays as a single ``.npz``
+payload per directory, and loading reconstructs by class-registry lookup the
+way Spark's ``DefaultParamsReader`` resolves ``className``.  Round-trip
+equality of predictions is test-enforced, as in the reference suites
+(e.g. `GBMClassifierSuite.scala:247-295`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _class_registry():
+    from spark_ensemble_tpu.models import (
+        bagging,
+        boosting,
+        dummy,
+        gbm,
+        linear,
+        naive_bayes,
+        stacking,
+        tree,
+    )
+    from spark_ensemble_tpu.ops.tree import Tree
+
+    modules = [bagging, boosting, dummy, gbm, linear, naive_bayes, stacking, tree]
+    registry: Dict[str, type] = {}
+    for mod in modules:
+        for name in dir(mod):
+            obj = getattr(mod, name)
+            if isinstance(obj, type):
+                registry[name] = obj
+    registry["Tree"] = Tree
+    return registry
+
+
+# ---------------------------------------------------------------------------
+# pytree <-> (json-structure, arrays) encoding
+# ---------------------------------------------------------------------------
+
+def _encode(obj: Any, arrays: Dict[str, np.ndarray], prefix: str):
+    if obj is None:
+        return None
+    if isinstance(obj, (bool, int, float, str)):
+        return obj
+    if hasattr(obj, "_fields"):  # NamedTuple (e.g. ops.tree.Tree)
+        return {
+            "__namedtuple__": type(obj).__name__,
+            "fields": {
+                f: _encode(getattr(obj, f), arrays, f"{prefix}.{f}")
+                for f in obj._fields
+            },
+        }
+    if isinstance(obj, dict):
+        return {
+            "__dict__": {
+                k: _encode(v, arrays, f"{prefix}.{k}") for k, v in obj.items()
+            }
+        }
+    if isinstance(obj, (list, tuple)):
+        return {
+            "__list__": [
+                _encode(v, arrays, f"{prefix}.{i}") for i, v in enumerate(obj)
+            ],
+            "__tuple__": isinstance(obj, tuple),
+        }
+    arr = np.asarray(obj)
+    arrays[prefix] = arr
+    return {"__array__": prefix}
+
+
+def _decode(spec: Any, arrays, registry):
+    if spec is None or isinstance(spec, (bool, int, float, str)):
+        return spec
+    if "__array__" in spec:
+        return jnp.asarray(arrays[spec["__array__"]])
+    if "__namedtuple__" in spec:
+        cls = registry[spec["__namedtuple__"]]
+        return cls(
+            **{k: _decode(v, arrays, registry) for k, v in spec["fields"].items()}
+        )
+    if "__dict__" in spec:
+        return {k: _decode(v, arrays, registry) for k, v in spec["__dict__"].items()}
+    if "__list__" in spec:
+        items = [_decode(v, arrays, registry) for v in spec["__list__"]]
+        return tuple(items) if spec.get("__tuple__") else items
+    raise ValueError(f"cannot decode {spec!r}")
+
+
+# ---------------------------------------------------------------------------
+# estimator configs (nested directories, like learner/ in the reference)
+# ---------------------------------------------------------------------------
+
+def _save_estimator_params(obj, path: str) -> Dict[str, Any]:
+    """Returns JSON param dict; writes nested estimator dirs under path."""
+    meta_params = obj.params_to_json_dict()
+    for name, p in obj._param_defs().items():
+        if not p.is_estimator:
+            continue
+        value = getattr(obj, name)
+        if value is None:
+            continue
+        if isinstance(value, (list, tuple)):
+            for i, v in enumerate(value):
+                save(v, os.path.join(path, f"{name}-{i}"))
+            meta_params[f"__{name}_count__"] = len(value)
+        else:
+            save(value, os.path.join(path, name))
+    return meta_params
+
+
+def _load_estimator_params(meta: Dict[str, Any], path: str, cls) -> Dict[str, Any]:
+    params = dict(meta["params"])
+    for name, p in cls._param_defs().items():
+        if not p.is_estimator:
+            continue
+        count_key = f"__{name}_count__"
+        if count_key in params:
+            count = params.pop(count_key)
+            params[name] = [
+                load(os.path.join(path, f"{name}-{i}")) for i in range(count)
+            ]
+        elif os.path.isdir(os.path.join(path, name)):
+            params[name] = load(os.path.join(path, name))
+    return params
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+_CHILD_ATTRS = ("init_model", "stack_model")
+_LIST_CHILD_ATTRS = ("base_models",)
+_EXTRA_ATTRS = ("num_features", "num_classes", "num_members", "dim")
+
+
+def save(obj, path: str) -> None:
+    """Save an Estimator or Model directory."""
+    os.makedirs(path, exist_ok=True)
+    meta: Dict[str, Any] = {
+        "class": type(obj).__name__,
+        "format_version": FORMAT_VERSION,
+    }
+    meta["params"] = _save_estimator_params(obj, path)
+
+    from spark_ensemble_tpu.models.base import Model
+
+    is_model = isinstance(obj, Model)
+    if is_model:
+        arrays: Dict[str, np.ndarray] = {}
+        meta["learned"] = _encode(obj.params, arrays, "p")
+        extra = {}
+        for attr in _EXTRA_ATTRS:
+            if hasattr(obj, attr):
+                extra[attr] = getattr(obj, attr)
+        meta["extra"] = extra
+        for attr in _CHILD_ATTRS:
+            child = getattr(obj, attr, None)
+            if child is not None:
+                save(child, os.path.join(path, f"model-{attr}"))
+                meta.setdefault("children", []).append(attr)
+        for attr in _LIST_CHILD_ATTRS:
+            children = getattr(obj, attr, None)
+            if children:
+                for i, child in enumerate(children):
+                    save(child, os.path.join(path, f"model-{attr}-{i}"))
+                meta.setdefault("list_children", {})[attr] = len(children)
+        if arrays:
+            np.savez(os.path.join(path, "arrays.npz"), **arrays)
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(meta, f, indent=2, default=float)
+
+
+def load(path: str):
+    """Load an Estimator or Model saved by :func:`save`."""
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+    registry = _class_registry()
+    cls = registry[meta["class"]]
+    kwargs = _load_estimator_params(meta, path, cls)
+
+    if "learned" in meta:
+        arrays = {}
+        npz = os.path.join(path, "arrays.npz")
+        if os.path.exists(npz):
+            arrays = dict(np.load(npz))
+        learned = _decode(meta["learned"], arrays, registry)
+        kwargs["params"] = learned
+        kwargs.update(meta.get("extra", {}))
+        for attr in meta.get("children", []):
+            kwargs[attr] = load(os.path.join(path, f"model-{attr}"))
+        for attr, count in meta.get("list_children", {}).items():
+            kwargs[attr] = [
+                load(os.path.join(path, f"model-{attr}-{i}")) for i in range(count)
+            ]
+    return cls(**kwargs)

@@ -1,0 +1,60 @@
+"""PRNG-key discipline and static-shape sampling kernels.
+
+The reference samples rows with Spark's ``RDD.sample`` — a *Poisson* sampler
+when ``withReplacement=true`` and a Bernoulli sampler otherwise
+(`BaggingRegressor.scala:149-150`, `GBMRegressor.scala:357-359`) — and draws
+Bernoulli feature-subspace masks with ``XORShiftRandom(seed)``
+(`HasSubBag.scala:73-79`).  Per-member seeds are ``seed + i``
+(`BaggingRegressor.scala:141-143`).
+
+The TPU build keeps shapes static by never materializing subsets: row
+sampling becomes an integer/float *weight vector* (Poisson counts or a 0/1
+Bernoulli mask) multiplied into per-sample weights, which is exactly the
+sufficient statistic the downstream weighted fits consume.  Feature subspaces
+become boolean masks that zero out split gains instead of slicing columns.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def member_keys(seed: int, num_members: int) -> jax.Array:
+    """Independent keys per ensemble member (reference: ``seed + i``)."""
+    return jax.random.split(jax.random.PRNGKey(seed), num_members)
+
+
+def bootstrap_weights(
+    key: jax.Array,
+    n: int,
+    replacement: bool,
+    subsample_ratio: float,
+) -> jax.Array:
+    """Row-sampling weights with Spark ``RDD.sample`` semantics.
+
+    replacement=True  -> Poisson(subsample_ratio) counts per row
+    replacement=False -> Bernoulli(subsample_ratio) 0/1 mask
+    Both keep the expected sampled-row count at ``n * subsample_ratio`` with
+    a static output shape ``f32[n]``.
+    """
+    if replacement:
+        return jax.random.poisson(key, subsample_ratio, (n,)).astype(jnp.float32)
+    return jax.random.bernoulli(key, subsample_ratio, (n,)).astype(jnp.float32)
+
+
+def subspace_mask(key: jax.Array, num_features: int, subspace_ratio: float) -> jax.Array:
+    """Bernoulli feature mask (reference `HasSubBag.scala:73-79`).
+
+    Guarantees at least one active feature (a fully-masked member would make
+    the base learner degenerate; the reference's estimators would fit on an
+    empty projection — we instead fall back to enabling the first drawn
+    feature, preserving expected mask size for any ratio > 0).
+    """
+    mask = jax.random.bernoulli(key, subspace_ratio, (num_features,))
+    # ensure >= 1 active feature: if empty, activate a uniformly drawn one
+    any_active = jnp.any(mask)
+    fallback = jnp.zeros((num_features,), bool).at[
+        jax.random.randint(key, (), 0, num_features)
+    ].set(True)
+    return jnp.where(any_active, mask, fallback)

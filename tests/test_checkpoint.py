@@ -1,0 +1,80 @@
+"""Training-state checkpoint/resume tests (SURVEY.md §5: the TPU build gets
+real mid-run resumability where the reference only truncated RDD lineage)."""
+
+import numpy as np
+import pytest
+
+import spark_ensemble_tpu as se
+from spark_ensemble_tpu.utils.checkpoint import TrainingCheckpointer
+
+
+def _data(n=800, d=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (2 * X[:, 0] + np.sin(3 * X[:, 1]) + 0.1 * rng.randn(n)).astype(np.float32)
+    return X, y
+
+
+def test_checkpointer_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    ck = TrainingCheckpointer(str(tmp_path / "ck"), interval=2)
+    state = {"v": 1, "best": 2.5, "pred": jnp.arange(4.0), "weights": [1.0, 2.0]}
+    ck.maybe_save(0, state)  # round 0: (0+1) % 2 != 0 -> skipped
+    assert ck.load_latest() is None
+    ck.maybe_save(1, state)
+    got = ck.load_latest()
+    assert got is not None
+    rnd, st = got
+    assert rnd == 1
+    assert st["v"] == 1
+    assert np.allclose(np.asarray(st["pred"]), [0, 1, 2, 3])
+    ck.delete()
+    assert ck.load_latest() is None
+
+
+def test_gbm_resume_matches_uninterrupted(tmp_path):
+    """Fit 6 rounds straight vs fit interrupted at round 4 + resumed: the
+    final models must predict identically."""
+    X, y = _data()
+    ckdir = str(tmp_path / "gbm_ck")
+
+    full = se.GBMRegressor(num_base_learners=6, seed=3).fit(X, y)
+
+    # "interrupted" run: checkpoint every 2 rounds, stop after round 3
+    class StopAt(Exception):
+        pass
+
+    est = se.GBMRegressor(
+        num_base_learners=4, seed=3, checkpoint_dir=ckdir, checkpoint_interval=2
+    )
+    est.fit(X, y)
+    # the 4-round run checkpointed at rounds 1 and 3 but completed, deleting
+    # its checkpoints; emulate preemption by re-creating the checkpoint:
+    ck = TrainingCheckpointer(ckdir, 2)
+    assert ck.load_latest() is None
+
+    # real interruption test: save a checkpoint manually mid-run by running
+    # 4 rounds with interval 4 (checkpoint at round 3 survives only if the
+    # run dies before delete) — emulate by monkeypatching delete to no-op
+    est2 = se.GBMRegressor(
+        num_base_learners=4, seed=3, checkpoint_dir=ckdir, checkpoint_interval=4
+    )
+    orig_delete = TrainingCheckpointer.delete
+    TrainingCheckpointer.delete = lambda self: None
+    try:
+        est2.fit(X, y)
+    finally:
+        TrainingCheckpointer.delete = orig_delete
+    import os
+
+    assert os.path.exists(os.path.join(ckdir, "latest", "state.json"))
+
+    # resume with the full budget: rounds 4..5 run on top of the restored state
+    resumed = se.GBMRegressor(
+        num_base_learners=6, seed=3, checkpoint_dir=ckdir, checkpoint_interval=100
+    ).fit(X, y)
+    a = np.asarray(full.predict(X[:100]))
+    b = np.asarray(resumed.predict(X[:100]))
+    assert resumed.num_members == full.num_members == 6
+    assert np.allclose(a, b, atol=1e-4), np.abs(a - b).max()

@@ -1,0 +1,149 @@
+"""GBM tests (mirrors `GBMRegressorSuite.scala` / `GBMClassifierSuite.scala`:
+beats-baseline, monotone prefix improvement, early-stop exactness)."""
+
+import numpy as np
+import pytest
+
+import spark_ensemble_tpu as se
+from tests.conftest import accuracy, rmse, split
+
+
+def test_gbm_regressor_beats_single_tree(cpusmall):
+    X, y = cpusmall
+    Xtr, ytr, Xte, yte = split(X, y)
+    tree = se.DecisionTreeRegressor(max_depth=5).fit(Xtr, ytr)
+    gbm = se.GBMRegressor(
+        base_learner=se.DecisionTreeRegressor(max_depth=5), num_base_learners=10
+    ).fit(Xtr, ytr)
+    assert rmse(gbm.predict(Xte), yte) < rmse(tree.predict(Xte), yte)
+
+
+@pytest.mark.parametrize("loss", ["squared", "absolute", "huber", "quantile"])
+def test_gbm_regressor_losses_train(cpusmall, loss):
+    X, y = cpusmall
+    Xtr, ytr, Xte, yte = split(X, y)
+    gbm = se.GBMRegressor(num_base_learners=5, loss=loss, alpha=0.5).fit(Xtr, ytr)
+    # every loss must do clearly better than predicting the train median
+    base = rmse(np.full_like(yte, float(np.median(ytr))), yte)
+    assert rmse(gbm.predict(Xte), yte) < base
+
+
+def test_gbm_regressor_newton_updates(cpusmall):
+    X, y = cpusmall
+    Xtr, ytr, Xte, yte = split(X, y)
+    gbm = se.GBMRegressor(num_base_learners=8, updates="newton").fit(Xtr, ytr)
+    tree = se.DecisionTreeRegressor(max_depth=5).fit(Xtr, ytr)
+    assert rmse(gbm.predict(Xte), yte) < rmse(tree.predict(Xte), yte)
+
+
+def test_gbm_prefix_models_mostly_improve(cpusmall):
+    """`GBMRegressorSuite.scala:126-164`: >= 0.8 of prefix steps improve."""
+    X, y = cpusmall
+    Xtr, ytr, Xte, yte = split(X, y)
+    gbm = se.GBMRegressor(num_base_learners=8).fit(Xtr, ytr)
+    errs = [rmse(gbm.take(k).predict(Xte), yte) for k in range(1, gbm.num_members + 1)]
+    improving = sum(b <= a for a, b in zip(errs, errs[1:]))
+    assert improving / max(len(errs) - 1, 1) >= 0.8
+
+
+def test_gbm_early_stop_matches_offline_sweep(cpusmall):
+    """`GBMRegressorSuite.scala:78-124`: the early-stopped member count equals
+    the index an offline sweep of prefix models finds."""
+    X, y = cpusmall
+    rng = np.random.RandomState(0)
+    vi = rng.rand(X.shape[0]) < 0.25
+    gbm_es = se.GBMRegressor(
+        num_base_learners=20, num_rounds=1, validation_tol=0.01, seed=5
+    ).fit(X, y, validation_indicator=vi)
+
+    # offline: train without early stop, sweep prefixes on the validation set
+    gbm_full = se.GBMRegressor(num_base_learners=20, seed=5).fit(
+        X[~vi], y[~vi]
+    )
+    from spark_ensemble_tpu.ops.losses import SquaredLoss
+
+    loss = SquaredLoss()
+    errors = []
+    for k in range(1, gbm_full.num_members + 1):
+        pred = np.asarray(gbm_full.take(k).predict(X[vi]))
+        errors.append(float(np.mean(0.5 * (pred - y[vi]) ** 2)))
+    # replay the reference patience rule (`GBMRegressor.scala:457-465`)
+    best, v, stop = errors[0], 0, len(errors)
+    for i, err in enumerate(errors[1:], start=1):
+        if best - err < 0.01 * max(err, 0.01):
+            v += 1
+        else:
+            best, v = err, 0
+        if v >= 1:
+            stop = i + 1
+            break
+    expected_members = stop - v
+    assert gbm_es.num_members == expected_members
+
+
+def test_gbm_classifier_beats_single_tree_multiclass(letter):
+    X, y = letter
+    Xtr, ytr, Xte, yte = split(X, y)
+    tree = se.DecisionTreeClassifier(max_depth=5).fit(Xtr, ytr)
+    gbm = se.GBMClassifier(
+        base_learner=se.DecisionTreeRegressor(max_depth=5), num_base_learners=5
+    ).fit(Xtr, ytr)
+    assert accuracy(gbm.predict(Xte), yte) > accuracy(tree.predict(Xte), yte)
+
+
+@pytest.mark.parametrize("loss", ["bernoulli", "exponential"])
+def test_gbm_classifier_binary_losses(adult_full, loss):
+    """`GBMClassifierSuite.scala:89-146` (binary, newton updates)."""
+    X, y = adult_full
+    Xtr, ytr, Xte, yte = split(X, y)
+    tree = se.DecisionTreeClassifier(max_depth=5).fit(Xtr, ytr)
+    gbm = se.GBMClassifier(num_base_learners=10, loss=loss, updates="newton").fit(
+        Xtr, ytr
+    )
+    assert accuracy(gbm.predict(Xte), yte) >= accuracy(tree.predict(Xte), yte) - 0.01
+
+
+def test_gbm_classifier_proba_shapes(letter):
+    X, y = letter
+    Xtr, ytr, Xte, _ = split(X, y)
+    k = int(y.max()) + 1
+    gbm = se.GBMClassifier(num_base_learners=3).fit(Xtr, ytr)
+    raw = np.asarray(gbm.predict_raw(Xte[:20]))
+    proba = np.asarray(gbm.predict_proba(Xte[:20]))
+    assert raw.shape == (20, k)
+    assert proba.shape == (20, k)
+    assert np.allclose(proba.sum(-1), 1.0, atol=1e-5)
+
+
+def test_gbm_subbagging_trains(cpusmall):
+    X, y = cpusmall
+    Xtr, ytr, Xte, yte = split(X, y)
+    gbm = se.GBMRegressor(
+        num_base_learners=8,
+        subsample_ratio=0.6,
+        subspace_ratio=0.8,
+        replacement=False,
+    ).fit(Xtr, ytr)
+    base = rmse(np.full_like(yte, float(np.mean(ytr))), yte)
+    assert rmse(gbm.predict(Xte), yte) < 0.7 * base
+
+
+def test_gbm_unoptimized_weights(cpusmall):
+    X, y = cpusmall
+    Xtr, ytr, Xte, yte = split(X, y)
+    gbm = se.GBMRegressor(
+        num_base_learners=5, optimized_weights=False, learning_rate=0.5
+    ).fit(Xtr, ytr)
+    base = rmse(np.full_like(yte, float(np.mean(ytr))), yte)
+    assert rmse(gbm.predict(Xte), yte) < base
+
+
+def test_gbm_init_strategies(cpusmall):
+    X, y = cpusmall
+    Xtr, ytr, Xte, yte = split(X, y)
+    for strategy in ["constant", "zero", "base"]:
+        gbm = se.GBMRegressor(num_base_learners=3, init_strategy=strategy).fit(
+            Xtr, ytr
+        )
+        base = rmse(np.full_like(yte, float(np.mean(ytr))), yte)
+        assert rmse(gbm.predict(Xte), yte) < base

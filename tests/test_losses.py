@@ -1,0 +1,125 @@
+"""Loss-layer property tests (mirrors `GBMLossSuite.scala:84-125`: numerical
+gradient checking of every loss and, via the (grad, hess) pair trick, of
+every hessian)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_ensemble_tpu.ops import losses as L
+
+ALL_LOSSES = [
+    L.SquaredLoss(),
+    L.AbsoluteLoss(),
+    L.LogCoshLoss(),
+    L.ScaledLogCoshLoss(0.3),
+    L.HuberLoss(1.3),
+    L.QuantileLoss(0.25),
+    L.LogLoss(5),
+    L.ExponentialLoss(),
+    L.BernoulliLoss(),
+]
+
+
+def _random_labels(loss, n, rng):
+    if isinstance(loss, L.LogLoss):
+        return jnp.asarray(rng.randint(0, loss.num_classes, n), jnp.float32)
+    if isinstance(loss, (L.ExponentialLoss, L.BernoulliLoss)):
+        return jnp.asarray(rng.randint(0, 2, n), jnp.float32)
+    return jnp.asarray(rng.randn(n), jnp.float32)
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+def test_gradient_matches_autodiff(loss):
+    rng = np.random.RandomState(0)
+    y = _random_labels(loss, 64, rng)
+    enc = loss.encode_label(y)
+    pred = jnp.asarray(rng.randn(64, loss.dim), jnp.float32)
+    auto = jax.grad(lambda p: jnp.sum(loss.loss(enc, p)))(pred)
+    manual = loss.gradient(enc, pred)
+    assert float(jnp.max(jnp.abs(auto - manual))) < 1e-4
+
+
+@pytest.mark.parametrize(
+    "loss",
+    [l for l in ALL_LOSSES if l.has_hessian],
+    ids=lambda l: l.name,
+)
+def test_hessian_matches_autodiff(loss):
+    """Treat (gradient, hessian) as a (loss, grad) pair: the hessian must be
+    the elementwise derivative of the gradient wrt the same output dim."""
+    rng = np.random.RandomState(1)
+    y = _random_labels(loss, 32, rng)
+    enc = loss.encode_label(y)
+    pred = jnp.asarray(rng.randn(32, loss.dim), jnp.float32)
+
+    def grad_k(p):
+        return jnp.sum(loss.gradient(enc, p))
+
+    # d(grad_j)/d(pred_j): diagonal of the per-dim jacobian
+    diag = jax.vmap(
+        lambda e, p: jnp.diag(jax.jacfwd(lambda q: loss.gradient(e[None], q[None])[0])(p))
+    )(enc, pred)
+    manual = loss.hessian(enc, pred)
+    assert float(jnp.max(jnp.abs(diag - manual))) < 1e-3
+
+
+def test_negative_gradient():
+    loss = L.SquaredLoss()
+    y = jnp.asarray([[1.0], [2.0]])
+    p = jnp.asarray([[0.5], [3.0]])
+    assert jnp.allclose(loss.negative_gradient(y, p), -loss.gradient(y, p))
+
+
+def test_logloss_encode_onehot():
+    loss = L.LogLoss(4)
+    enc = loss.encode_label(jnp.asarray([0.0, 3.0]))
+    assert enc.shape == (2, 4)
+    assert jnp.allclose(enc[0], jnp.asarray([1, 0, 0, 0]))
+    assert jnp.allclose(enc[1], jnp.asarray([0, 0, 0, 1]))
+
+
+def test_plus_minus_one_encoding():
+    for loss in [L.ExponentialLoss(), L.BernoulliLoss()]:
+        enc = loss.encode_label(jnp.asarray([0.0, 1.0]))
+        assert jnp.allclose(enc[:, 0], jnp.asarray([-1.0, 1.0]))
+
+
+def test_raw2probability_logloss_softmax():
+    loss = L.LogLoss(3)
+    raw = jnp.asarray([[1.0, 2.0, 3.0]])
+    p = loss.raw2probability(raw)
+    assert jnp.allclose(jnp.sum(p, axis=-1), 1.0, atol=1e-6)
+    assert jnp.allclose(p, jax.nn.softmax(raw, axis=-1))
+
+
+def test_raw2probability_bernoulli_orientation():
+    """With the GBM binary raw convention (-f, f), P(y=1) must be sigmoid(f)
+    (`GBMLoss.scala:311-316` composed with `GBMClassifier.scala:583-587`)."""
+    loss = L.BernoulliLoss()
+    f = jnp.asarray([[2.0]])
+    raw = jnp.concatenate([-f, f], axis=1)
+    p = loss.raw2probability(raw)
+    assert float(p[0, 1]) == pytest.approx(float(jax.nn.sigmoid(2.0)), abs=1e-6)
+
+
+def test_aggregate_loss_weighted_mean():
+    loss = L.SquaredLoss()
+    y = jnp.asarray([1.0, 2.0, 3.0])
+    enc = loss.encode_label(y)
+    pred = jnp.zeros((3, 1))
+    w = jnp.asarray([1.0, 0.0, 1.0])
+    got = L.aggregate_loss(loss, enc, w, pred)
+    assert float(got) == pytest.approx((0.5 * 1 + 0.5 * 9) / 2.0, rel=1e-6)
+
+
+def test_registry_roundtrip():
+    for cfg in [
+        {"name": "huber", "delta": 2.0},
+        {"name": "quantile", "quantile": 0.2},
+        {"name": "logloss", "num_classes": 7},
+        {"name": "squared"},
+    ]:
+        loss = L.loss_from_config(cfg)
+        assert loss.config()["name"] == cfg["name"]

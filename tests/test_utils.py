@@ -1,0 +1,77 @@
+"""Weighted median/quantile and sampling property tests (mirrors
+`UtilsSuite.scala:29-67` and `HasSubBagSuite.scala:60-105`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_ensemble_tpu.utils.quantile import weighted_median, weighted_quantile
+from spark_ensemble_tpu.utils.random import bootstrap_weights, subspace_mask
+
+
+def test_weighted_median_equals_unweighted_for_unit_weights():
+    rng = np.random.RandomState(0)
+    for trial in range(10):
+        v = rng.randn(101).astype(np.float32)  # odd count: unique median
+        got = float(weighted_median(jnp.asarray(v), jnp.ones(101)))
+        assert got == pytest.approx(float(np.median(v)), abs=1e-6)
+
+
+def test_weighted_median_ignores_zero_weights():
+    v = jnp.asarray([100.0, 1.0, 2.0, 3.0, 200.0])
+    w = jnp.asarray([0.0, 1.0, 1.0, 1.0, 0.0])
+    assert float(weighted_median(v, w)) == 2.0
+
+
+def test_weighted_median_scale_invariant_in_weights():
+    rng = np.random.RandomState(1)
+    v = jnp.asarray(rng.randn(50), jnp.float32)
+    w = jnp.asarray(rng.rand(50) + 0.1, jnp.float32)
+    a = float(weighted_median(v, w))
+    b = float(weighted_median(v, 7.3 * w))
+    assert a == b
+
+
+def test_weighted_median_dominant_weight():
+    v = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    w = jnp.asarray([1.0, 1.0, 1.0, 10.0])
+    assert float(weighted_median(v, w)) == 4.0
+
+
+def test_weighted_quantile_matches_numpy_on_uniform_weights():
+    rng = np.random.RandomState(2)
+    v = rng.randn(500).astype(np.float32)
+    for q in [0.1, 0.5, 0.9]:
+        got = float(weighted_quantile(jnp.asarray(v), q))
+        # inverted-CDF quantile: within one order statistic of numpy's
+        expect = np.quantile(v, q, method="inverted_cdf")
+        assert got == pytest.approx(float(expect), abs=1e-5)
+
+
+def test_subspace_mask_expected_size_and_nonempty():
+    key = jax.random.PRNGKey(0)
+    d = 200
+    sizes = []
+    for i in range(50):
+        m = subspace_mask(jax.random.fold_in(key, i), d, 0.3)
+        sizes.append(int(jnp.sum(m)))
+        assert sizes[-1] >= 1
+    assert np.mean(sizes) == pytest.approx(0.3 * d, rel=0.15)
+
+
+def test_subspace_mask_ratio_one_is_identity():
+    m = subspace_mask(jax.random.PRNGKey(3), 17, 1.0)
+    assert bool(jnp.all(m))
+
+
+def test_bootstrap_weights_poisson_expectation():
+    w = bootstrap_weights(jax.random.PRNGKey(0), 20000, True, 0.7)
+    assert float(jnp.mean(w)) == pytest.approx(0.7, rel=0.05)
+    assert float(jnp.max(w)) > 1.0  # replacement -> counts can exceed 1
+
+
+def test_bootstrap_weights_bernoulli():
+    w = bootstrap_weights(jax.random.PRNGKey(0), 20000, False, 0.4)
+    assert set(np.unique(np.asarray(w))) <= {0.0, 1.0}
+    assert float(jnp.mean(w)) == pytest.approx(0.4, rel=0.05)
