@@ -60,6 +60,26 @@ from spark_ensemble_tpu.models.tree import (
     DecisionTreeRegressionModel,
     DecisionTreeRegressor,
 )
+from spark_ensemble_tpu.evaluation import (
+    BinaryClassificationEvaluator,
+    MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
+from spark_ensemble_tpu.pipeline import (
+    MinMaxScaler,
+    MinMaxScalerModel,
+    Pipeline,
+    PipelineModel,
+    StandardScaler,
+    StandardScalerModel,
+)
+from spark_ensemble_tpu.tuning import (
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
+    TrainValidationSplit,
+    TrainValidationSplitModel,
+)
 from spark_ensemble_tpu.utils.persist import load
 
 __version__ = "0.1.0"
@@ -95,5 +115,19 @@ __all__ = [
     "LogisticRegressionModel",
     "GaussianNaiveBayes",
     "GaussianNaiveBayesModel",
+    "RegressionEvaluator",
+    "MulticlassClassificationEvaluator",
+    "BinaryClassificationEvaluator",
+    "ParamGridBuilder",
+    "CrossValidator",
+    "CrossValidatorModel",
+    "TrainValidationSplit",
+    "TrainValidationSplitModel",
+    "Pipeline",
+    "PipelineModel",
+    "StandardScaler",
+    "StandardScalerModel",
+    "MinMaxScaler",
+    "MinMaxScalerModel",
     "load",
 ]

@@ -82,6 +82,19 @@ def in_array(values):
     return lambda v: (v.lower() if isinstance(v, str) else v) in values
 
 
+def _jsonable(value) -> bool:
+    """True when value is representable as plain JSON."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return True
+    if isinstance(value, (list, tuple)):
+        return all(_jsonable(v) for v in value)
+    if isinstance(value, dict):
+        return all(
+            isinstance(k, str) and _jsonable(v) for k, v in value.items()
+        )
+    return False
+
+
 class Params:
     """Base class with declared-``Param`` bookkeeping.
 
@@ -148,7 +161,10 @@ class Params:
             value = getattr(self, name)
             if value is None or isinstance(value, (bool, int, float, str)):
                 out[name] = value
-            elif isinstance(value, (list, tuple)):
+            elif isinstance(value, (list, tuple)) and _jsonable(value):
+                # non-JSON containers (e.g. a tuning grid sweeping
+                # estimator-valued params) are dropped from metadata rather
+                # than crashing save(); learned state round-trips regardless
                 out[name] = list(value)
         return out
 

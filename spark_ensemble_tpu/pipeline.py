@@ -1,0 +1,172 @@
+"""Pipelines and feature transformers.
+
+The reference's estimators extend Spark ``Predictor`` precisely so they
+compose with ``Pipeline`` stages and feature transformers
+(SURVEY.md §1 L5; reference `docs/example.md`).  This module supplies the
+array-native equivalent: a ``Pipeline`` of fitted transformer stages ending
+in (optionally) a predictor, where every transformer is a jitted array
+kernel rather than a DataFrame column UDF.
+
+Transformers follow the Estimator/Model split: ``StandardScaler().fit(X)``
+returns a ``StandardScalerModel`` whose ``transform`` is pure and jittable,
+so a whole pipeline's feature path fuses into the downstream model's XLA
+program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+
+from spark_ensemble_tpu.models.base import Estimator, Model, as_f32
+from spark_ensemble_tpu.params import Param, Params
+
+
+class Transformer(Params):
+    """A stateless or fitted feature transform ``X -> X'``."""
+
+    def transform(self, X) -> jax.Array:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Feature transformers
+# ---------------------------------------------------------------------------
+
+
+class StandardScaler(Estimator):
+    """Column standardization (Spark ``ml.feature.StandardScaler``)."""
+
+    with_mean = Param(True)
+    with_std = Param(True)
+
+    def fit(self, X, y=None, sample_weight=None) -> "StandardScalerModel":
+        X = as_f32(X)
+        mean = jnp.mean(X, axis=0)
+        std = jnp.std(X, axis=0)
+        return StandardScalerModel(
+            params={"mean": mean, "scale": jnp.maximum(std, 1e-12)},
+            num_features=X.shape[1],
+            **self.get_params(),
+        )
+
+
+class StandardScalerModel(Model, StandardScaler):
+    def transform(self, X):
+        X = as_f32(X)
+        if self.with_mean:
+            X = X - self.params["mean"]
+        if self.with_std:
+            X = X / self.params["scale"]
+        return X
+
+    def predict(self, X):  # transformers are not predictors
+        raise TypeError("StandardScalerModel is a transformer; use transform()")
+
+
+class MinMaxScaler(Estimator):
+    """Rescale columns to [min, max] (Spark ``ml.feature.MinMaxScaler``)."""
+
+    feature_min = Param(0.0)
+    feature_max = Param(1.0)
+
+    def fit(self, X, y=None, sample_weight=None) -> "MinMaxScalerModel":
+        X = as_f32(X)
+        lo = jnp.min(X, axis=0)
+        hi = jnp.max(X, axis=0)
+        return MinMaxScalerModel(
+            params={"lo": lo, "range": jnp.maximum(hi - lo, 1e-12)},
+            num_features=X.shape[1],
+            **self.get_params(),
+        )
+
+
+class MinMaxScalerModel(Model, MinMaxScaler):
+    def transform(self, X):
+        X = as_f32(X)
+        unit = (X - self.params["lo"]) / self.params["range"]
+        return unit * (self.feature_max - self.feature_min) + self.feature_min
+
+    def predict(self, X):
+        raise TypeError("MinMaxScalerModel is a transformer; use transform()")
+
+
+# ---------------------------------------------------------------------------
+# Pipeline
+# ---------------------------------------------------------------------------
+
+
+class Pipeline(Estimator):
+    """Fit stages left to right; transformer outputs feed later stages
+    (Spark ``ml.Pipeline``).  Stages may be transformer estimators (fitted to
+    models exposing ``transform``), already-fitted transformers, or a final
+    predictor estimator."""
+
+    stages = Param(None, is_estimator=True)
+
+    def fit(self, X, y=None, sample_weight=None) -> "PipelineModel":
+        fitted: List[Any] = []
+        Xc = as_f32(X)
+        num_features = Xc.shape[1]
+        for stage in list(self.stages or []):
+            if isinstance(stage, (Transformer, Model)):
+                # already-fitted stages pass through untouched (Spark
+                # semantics: a fitted Model in a Pipeline is a transformer
+                # stage, never re-fit)
+                fitted.append(stage)
+                if hasattr(stage, "transform"):
+                    Xc = stage.transform(Xc)
+            elif isinstance(stage, Estimator):
+                model = stage.fit(Xc, y, sample_weight=sample_weight)
+                fitted.append(model)
+                if hasattr(model, "transform"):
+                    Xc = model.transform(Xc)
+            else:
+                raise TypeError(f"invalid pipeline stage {stage!r}")
+        num_classes = next(
+            (m.num_classes for m in fitted if hasattr(m, "num_classes")), None
+        )
+        return PipelineModel(
+            stage_models=fitted,
+            num_features=num_features,
+            num_classes=num_classes,
+            **self.get_params(),
+        )
+
+
+class PipelineModel(Model, Pipeline):
+    def __init__(self, stage_models=None, num_classes=None, **kwargs):
+        super().__init__(**kwargs)
+        self.stage_models = stage_models or []
+        self.num_classes = num_classes
+
+    def _features(self, X):
+        Xc = as_f32(X)
+        for stage in self.stage_models[:-1]:
+            Xc = stage.transform(Xc)
+        return Xc
+
+    @property
+    def _final(self):
+        return self.stage_models[-1]
+
+    def transform(self, X):
+        """Apply every transformer stage; a final predictor stage (no
+        ``transform``) is skipped, so the result is the feature matrix the
+        final predictor consumes."""
+        Xc = as_f32(X)
+        for stage in self.stage_models:
+            if hasattr(stage, "transform"):
+                Xc = stage.transform(Xc)
+        return Xc
+
+    def predict(self, X):
+        return self._final.predict(self._features(X))
+
+    def predict_raw(self, X):
+        return self._final.predict_raw(self._features(X))
+
+    def predict_proba(self, X):
+        return self._final.predict_proba(self._features(X))

@@ -1,0 +1,204 @@
+"""Model selection: ParamGridBuilder, CrossValidator, TrainValidationSplit.
+
+The reference relies on Spark's tuning stack — `docs/example.md` wraps a
+``BaggingClassifier`` in a ``CrossValidator`` over a ``ParamGridBuilder``
+grid with a ``MulticlassClassificationEvaluator``.  This module supplies the
+TPU-native equivalents over the framework's array-based estimators.
+
+Design notes (vs Spark):
+- Fold assignment is a hash-free ``jax.random.permutation`` split (Spark
+  uses per-row Bernoulli hashing); folds are near-equal-sized.
+- Each (param-map, fold) fit is an independent jit-compiled program run in a
+  host loop — the analogue of ``CrossValidator``'s driver-side ``Future``
+  pool (`parallelism` is accepted for API parity).  Homogeneous-config
+  sweeps reuse each estimator's cached round-step compilations across folds
+  because shapes match fold-to-fold.
+- ``CrossValidatorModel.avg_metrics`` matches Spark's name/meaning; the
+  best map refits on the full data, like Spark.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from spark_ensemble_tpu.evaluation import Evaluator
+from spark_ensemble_tpu.models.base import Estimator, Model
+from spark_ensemble_tpu.params import Param, Params, gt_eq, in_range
+
+logger = logging.getLogger(__name__)
+
+
+class ParamGridBuilder:
+    """Cartesian-product grids of estimator params (Spark ``ParamGridBuilder``)."""
+
+    def __init__(self):
+        self._grid: Dict[str, Sequence[Any]] = {}
+
+    def add_grid(self, name: str, values: Sequence[Any]) -> "ParamGridBuilder":
+        self._grid[name] = list(values)
+        return self
+
+    def base_on(self, fixed: Dict[str, Any]) -> "ParamGridBuilder":
+        for name, value in fixed.items():
+            self._grid[name] = [value]
+        return self
+
+    def build(self) -> List[Dict[str, Any]]:
+        names = list(self._grid)
+        combos = itertools.product(*(self._grid[n] for n in names))
+        return [dict(zip(names, c)) for c in combos]
+
+
+def _kfold_indices(n: int, num_folds: int, seed: int) -> List[np.ndarray]:
+    """Shuffled, near-equal fold membership arrays (bool[n] per fold)."""
+    perm = np.asarray(jax.random.permutation(jax.random.PRNGKey(seed), n))
+    folds = []
+    for f in range(num_folds):
+        mask = np.zeros((n,), bool)
+        mask[perm[f::num_folds]] = True
+        folds.append(mask)
+    return folds
+
+
+def _fit_and_eval(estimator, pmap, evaluator, X, y, w, train_mask, eval_mask):
+    est = estimator.copy(**pmap)
+    Xt, yt = X[train_mask], y[train_mask]
+    wt = w[train_mask] if w is not None else None
+    model = est.fit(Xt, yt, sample_weight=wt)
+    Xe, ye = X[eval_mask], y[eval_mask]
+    we = w[eval_mask] if w is not None else None
+    return model, evaluator.evaluate(model, Xe, ye, sample_weight=we)
+
+
+class _TuningParams(Estimator):
+    estimator = Param(None, is_estimator=True)
+    evaluator = Param(None, is_estimator=True)
+    estimator_param_maps = Param(None)
+    parallelism = Param(1, gt_eq(1), doc="API parity; fits run back-to-back")
+    seed = Param(0)
+
+    def _maps(self) -> List[Dict[str, Any]]:
+        return list(self.estimator_param_maps or [{}])
+
+
+class CrossValidator(_TuningParams):
+    """k-fold CV over a param grid (Spark ``CrossValidator``)."""
+
+    num_folds = Param(3, gt_eq(2))
+
+    def fit(self, X, y, sample_weight=None) -> "CrossValidatorModel":
+        X = np.asarray(X)
+        y = np.asarray(y)
+        w = None if sample_weight is None else np.asarray(sample_weight)
+        evaluator: Evaluator = self.evaluator
+        maps = self._maps()
+        folds = _kfold_indices(X.shape[0], self.num_folds, self.seed)
+        metrics = np.zeros((len(maps), self.num_folds))
+        for fi, eval_mask in enumerate(folds):
+            train_mask = ~eval_mask
+            for mi, pmap in enumerate(maps):
+                _, metric = _fit_and_eval(
+                    self.estimator, pmap, evaluator, X, y, w, train_mask, eval_mask
+                )
+                metrics[mi, fi] = metric
+                logger.info("CV fold %d map %d: %.5f", fi, mi, metric)
+        avg = metrics.mean(axis=1)
+        best_idx = int(np.argmax(avg) if evaluator.is_larger_better else np.argmin(avg))
+        best_est = self.estimator.copy(**maps[best_idx])
+        best_model = best_est.fit(X, y, sample_weight=w)
+        return CrossValidatorModel(
+            best_model=best_model,
+            avg_metrics=avg.tolist(),
+            fold_metrics=metrics.tolist(),
+            best_index=best_idx,
+            **self.get_params(),
+        )
+
+
+class CrossValidatorModel(Model, CrossValidator):
+    def __init__(
+        self,
+        best_model: Optional[Model] = None,
+        avg_metrics: Optional[List[float]] = None,
+        fold_metrics=None,
+        best_index: int = 0,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.best_model = best_model
+        self.avg_metrics = avg_metrics or []
+        self.fold_metrics = fold_metrics or []
+        self.best_index = best_index
+
+    def predict(self, X):
+        return self.best_model.predict(X)
+
+    def predict_raw(self, X):
+        return self.best_model.predict_raw(X)
+
+    def predict_proba(self, X):
+        return self.best_model.predict_proba(X)
+
+
+class TrainValidationSplit(_TuningParams):
+    """Single random train/validation split sweep (Spark ``TrainValidationSplit``)."""
+
+    train_ratio = Param(0.75, in_range(0.0, 1.0, lower_inclusive=False, upper_inclusive=False))
+
+    def fit(self, X, y, sample_weight=None) -> "TrainValidationSplitModel":
+        X = np.asarray(X)
+        y = np.asarray(y)
+        w = None if sample_weight is None else np.asarray(sample_weight)
+        evaluator: Evaluator = self.evaluator
+        maps = self._maps()
+        n = X.shape[0]
+        perm = np.asarray(jax.random.permutation(jax.random.PRNGKey(self.seed), n))
+        n_train = int(n * self.train_ratio)
+        train_mask = np.zeros((n,), bool)
+        train_mask[perm[:n_train]] = True
+        eval_mask = ~train_mask
+        metrics = np.zeros((len(maps),))
+        for mi, pmap in enumerate(maps):
+            _, metric = _fit_and_eval(
+                self.estimator, pmap, evaluator, X, y, w, train_mask, eval_mask
+            )
+            metrics[mi] = metric
+            logger.info("TVS map %d: %.5f", mi, metric)
+        best_idx = int(
+            np.argmax(metrics) if evaluator.is_larger_better else np.argmin(metrics)
+        )
+        best_model = self.estimator.copy(**maps[best_idx]).fit(X, y, sample_weight=w)
+        return TrainValidationSplitModel(
+            best_model=best_model,
+            validation_metrics=metrics.tolist(),
+            best_index=best_idx,
+            **self.get_params(),
+        )
+
+
+class TrainValidationSplitModel(Model, TrainValidationSplit):
+    def __init__(
+        self,
+        best_model: Optional[Model] = None,
+        validation_metrics: Optional[List[float]] = None,
+        best_index: int = 0,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.best_model = best_model
+        self.validation_metrics = validation_metrics or []
+        self.best_index = best_index
+
+    def predict(self, X):
+        return self.best_model.predict(X)
+
+    def predict_raw(self, X):
+        return self.best_model.predict_raw(X)
+
+    def predict_proba(self, X):
+        return self.best_model.predict_proba(X)

@@ -24,6 +24,7 @@ FORMAT_VERSION = 1
 
 
 def _class_registry():
+    from spark_ensemble_tpu import evaluation, pipeline, tuning
     from spark_ensemble_tpu.models import (
         bagging,
         boosting,
@@ -36,7 +37,19 @@ def _class_registry():
     )
     from spark_ensemble_tpu.ops.tree import Tree
 
-    modules = [bagging, boosting, dummy, gbm, linear, naive_bayes, stacking, tree]
+    modules = [
+        bagging,
+        boosting,
+        dummy,
+        gbm,
+        linear,
+        naive_bayes,
+        stacking,
+        tree,
+        evaluation,
+        pipeline,
+        tuning,
+    ]
     registry: Dict[str, type] = {}
     for mod in modules:
         for name in dir(mod):
@@ -142,9 +155,18 @@ def _load_estimator_params(meta: Dict[str, Any], path: str, cls) -> Dict[str, An
 # public API
 # ---------------------------------------------------------------------------
 
-_CHILD_ATTRS = ("init_model", "stack_model")
-_LIST_CHILD_ATTRS = ("base_models",)
-_EXTRA_ATTRS = ("num_features", "num_classes", "num_members", "dim")
+_CHILD_ATTRS = ("init_model", "stack_model", "best_model")
+_LIST_CHILD_ATTRS = ("base_models", "stage_models")
+_EXTRA_ATTRS = (
+    "num_features",
+    "num_classes",
+    "num_members",
+    "dim",
+    "avg_metrics",
+    "fold_metrics",
+    "validation_metrics",
+    "best_index",
+)
 
 
 def save(obj, path: str) -> None:

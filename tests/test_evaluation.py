@@ -1,0 +1,135 @@
+"""Evaluator metric kernels vs straightforward numpy references."""
+
+import numpy as np
+import pytest
+
+from spark_ensemble_tpu.evaluation import (
+    BinaryClassificationEvaluator,
+    MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
+
+
+class _FixedModel:
+    """Stub model returning canned predictions/probabilities."""
+
+    def __init__(self, pred=None, proba=None, num_classes=None):
+        self._pred = pred
+        self._proba = proba
+        self.num_classes = num_classes
+
+    def predict(self, X):
+        return self._pred
+
+    def predict_proba(self, X):
+        return self._proba
+
+
+def test_regression_metrics_match_numpy():
+    rng = np.random.RandomState(0)
+    y = rng.randn(500).astype(np.float32)
+    pred = y + 0.3 * rng.randn(500).astype(np.float32)
+    model = _FixedModel(pred=pred)
+    X = np.zeros((500, 1))
+    err = pred - y
+    assert RegressionEvaluator(metric="mse").evaluate(model, X, y) == pytest.approx(
+        np.mean(err**2), rel=1e-5
+    )
+    assert RegressionEvaluator(metric="rmse").evaluate(model, X, y) == pytest.approx(
+        np.sqrt(np.mean(err**2)), rel=1e-5
+    )
+    assert RegressionEvaluator(metric="mae").evaluate(model, X, y) == pytest.approx(
+        np.mean(np.abs(err)), rel=1e-5
+    )
+    r2_ref = 1.0 - np.mean(err**2) / np.var(y)
+    assert RegressionEvaluator(metric="r2").evaluate(model, X, y) == pytest.approx(
+        r2_ref, rel=1e-4
+    )
+    assert RegressionEvaluator(metric="rmse").is_larger_better is False
+    assert RegressionEvaluator(metric="r2").is_larger_better is True
+
+
+def test_regression_weighted():
+    y = np.array([0.0, 0.0], np.float32)
+    pred = np.array([1.0, 3.0], np.float32)
+    w = np.array([3.0, 1.0], np.float32)
+    model = _FixedModel(pred=pred)
+    got = RegressionEvaluator(metric="mse").evaluate(
+        model, np.zeros((2, 1)), y, sample_weight=w
+    )
+    assert got == pytest.approx((3 * 1 + 1 * 9) / 4.0, rel=1e-6)
+
+
+def test_multiclass_accuracy_and_f1():
+    y = np.array([0, 0, 1, 1, 2, 2], np.float32)
+    pred = np.array([0, 1, 1, 1, 2, 0], np.float32)
+    model = _FixedModel(pred=pred, num_classes=3)
+    X = np.zeros((6, 1))
+    acc = MulticlassClassificationEvaluator(metric="accuracy").evaluate(model, X, y)
+    assert acc == pytest.approx(4 / 6, rel=1e-6)
+    ham = MulticlassClassificationEvaluator(metric="hammingLoss").evaluate(model, X, y)
+    assert ham == pytest.approx(2 / 6, rel=1e-6)
+    # sklearn weighted-f1 for this table is 0.6555...
+    f1 = MulticlassClassificationEvaluator(metric="f1").evaluate(model, X, y)
+    # per-class: c0 p=1/2 r=1/2 f=1/2; c1 p=2/3 r=1 f=0.8; c2 p=1 r=1/2 f=2/3
+    expect = (2 * 0.5 + 2 * 0.8 + 2 * (2 / 3)) / 6
+    assert f1 == pytest.approx(expect, rel=1e-5)
+    wp = MulticlassClassificationEvaluator(metric="weightedPrecision").evaluate(
+        model, X, y
+    )
+    assert wp == pytest.approx((2 * 0.5 + 2 * (2 / 3) + 2 * 1.0) / 6, rel=1e-5)
+
+
+def test_multiclass_logloss():
+    y = np.array([0, 1], np.float32)
+    proba = np.array([[0.8, 0.2], [0.4, 0.6]], np.float32)
+    model = _FixedModel(proba=proba, num_classes=2)
+    got = MulticlassClassificationEvaluator(metric="logLoss").evaluate(
+        model, np.zeros((2, 1)), y
+    )
+    assert got == pytest.approx(-(np.log(0.8) + np.log(0.6)) / 2, rel=1e-5)
+
+
+def test_binary_auc_perfect_and_random():
+    n = 1000
+    rng = np.random.RandomState(1)
+    y = (rng.rand(n) > 0.5).astype(np.float32)
+    # perfect ranking
+    proba = np.stack([1 - y, y], axis=1).astype(np.float32)
+    proba = np.clip(proba + 0.01 * rng.rand(n, 1), 0, 1)
+    model = _FixedModel(proba=proba)
+    auc = BinaryClassificationEvaluator(metric="areaUnderROC").evaluate(
+        model, np.zeros((n, 1)), y
+    )
+    assert auc > 0.99
+    # random scores -> AUC ~ 0.5
+    score = rng.rand(n).astype(np.float32)
+    model = _FixedModel(proba=np.stack([1 - score, score], axis=1))
+    auc = BinaryClassificationEvaluator(metric="areaUnderROC").evaluate(
+        model, np.zeros((n, 1)), y
+    )
+    assert 0.45 < auc < 0.55
+    pr = BinaryClassificationEvaluator(metric="areaUnderPR").evaluate(
+        model, np.zeros((n, 1)), y
+    )
+    base_rate = float(np.mean(y))
+    assert abs(pr - base_rate) < 0.1
+
+
+def test_binary_auc_tied_scores_give_chance_level():
+    """A constant scorer must get AUC 0.5 regardless of row order (tie
+    handling: one curve point per distinct threshold)."""
+    y = np.array([1.0] * 50 + [0.0] * 50, np.float32)
+    proba = np.full((100, 2), 0.5, np.float32)
+    ev = BinaryClassificationEvaluator(metric="areaUnderROC")
+    model = _FixedModel(proba=proba)
+    assert ev.evaluate(model, np.zeros((100, 1)), y) == pytest.approx(0.5, abs=1e-6)
+    assert ev.evaluate(model, np.zeros((100, 1)), y[::-1]) == pytest.approx(
+        0.5, abs=1e-6
+    )
+    # two tied blocks: all positives scored high, ties within blocks
+    y2 = np.array([1, 1, 0, 0], np.float32)
+    proba2 = np.array([[0.1, 0.9], [0.1, 0.9], [0.9, 0.1], [0.9, 0.1]], np.float32)
+    assert ev.evaluate(_FixedModel(proba=proba2), np.zeros((4, 1)), y2) == pytest.approx(
+        1.0, abs=1e-6
+    )

@@ -1,0 +1,153 @@
+"""CrossValidator / TrainValidationSplit / Pipeline behavior (the model-
+selection composition the reference gets from Spark, `docs/example.md`)."""
+
+import numpy as np
+import pytest
+
+from spark_ensemble_tpu import (
+    BaggingClassifier,
+    CrossValidator,
+    DecisionTreeRegressor,
+    GBMRegressor,
+    MinMaxScaler,
+    MulticlassClassificationEvaluator,
+    ParamGridBuilder,
+    Pipeline,
+    RegressionEvaluator,
+    StandardScaler,
+    TrainValidationSplit,
+    load,
+)
+from tests.conftest import accuracy, rmse, split
+
+
+def test_param_grid_builder():
+    grid = (
+        ParamGridBuilder()
+        .add_grid("num_base_learners", [5, 10])
+        .add_grid("learning_rate", [0.1, 0.3, 1.0])
+        .base_on({"seed": 7})
+        .build()
+    )
+    assert len(grid) == 6
+    assert all(g["seed"] == 7 for g in grid)
+    assert {g["learning_rate"] for g in grid} == {0.1, 0.3, 1.0}
+
+
+def test_cross_validator_picks_better_depth(letter):
+    X_tr, y_tr, X_te, y_te = split(*letter)
+    grid = ParamGridBuilder().add_grid("num_base_learners", [1, 8]).build()
+    cv = CrossValidator(
+        estimator=BaggingClassifier(subspace_ratio=0.6, subsample_ratio=0.7),
+        estimator_param_maps=grid,
+        evaluator=MulticlassClassificationEvaluator(metric="accuracy"),
+        num_folds=3,
+        seed=0,
+    )
+    cv_model = cv.fit(X_tr, y_tr)
+    assert len(cv_model.avg_metrics) == 2
+    # more members should win, and the refit model should predict well
+    assert cv_model.best_index == 1
+    assert cv_model.avg_metrics[1] >= cv_model.avg_metrics[0]
+    assert accuracy(cv_model.predict(X_te), y_te) > 0.3
+
+
+def test_train_validation_split_regression(cpusmall):
+    X_tr, y_tr, X_te, y_te = split(*cpusmall)
+    grid = ParamGridBuilder().add_grid("num_base_learners", [2, 20]).build()
+    tvs = TrainValidationSplit(
+        estimator=GBMRegressor(learning_rate=0.3),
+        estimator_param_maps=grid,
+        evaluator=RegressionEvaluator(metric="rmse"),
+        train_ratio=0.75,
+        seed=0,
+    )
+    model = tvs.fit(X_tr, y_tr)
+    assert len(model.validation_metrics) == 2
+    assert model.best_index == 1  # 20 rounds beats 2
+    assert rmse(model.predict(X_te), y_te) < rmse(np.full_like(y_te, y_te.mean()), y_te)
+
+
+def test_pipeline_scaler_then_gbm(cpusmall):
+    X_tr, y_tr, X_te, y_te = split(*cpusmall)
+    pipe = Pipeline(
+        stages=[StandardScaler(), GBMRegressor(num_base_learners=10, learning_rate=0.3)]
+    )
+    model = pipe.fit(X_tr, y_tr)
+    r = rmse(model.predict(X_te), y_te)
+    assert r < rmse(np.full_like(y_te, y_te.mean()), y_te)
+    # scaling is affine-monotone per column; tree-based GBM is invariant, so
+    # the piped model should match the unpiped one closely
+    direct = GBMRegressor(num_base_learners=10, learning_rate=0.3).fit(X_tr, y_tr)
+    assert r == pytest.approx(rmse(direct.predict(X_te), y_te), abs=0.3)
+
+
+def test_pipeline_transformers_compose():
+    rng = np.random.RandomState(0)
+    X = rng.randn(100, 3).astype(np.float32) * 10 + 5
+    scaled = StandardScaler().fit(X).transform(X)
+    assert np.allclose(np.asarray(scaled).mean(axis=0), 0.0, atol=1e-4)
+    assert np.allclose(np.asarray(scaled).std(axis=0), 1.0, atol=1e-3)
+    unit = MinMaxScaler().fit(X).transform(X)
+    unit = np.asarray(unit)
+    assert unit.min() >= -1e-6 and unit.max() <= 1 + 1e-6
+
+
+def test_pipeline_fitted_stage_passthrough(cpusmall):
+    """A pre-fitted Model stage must pass through untouched, never re-fit
+    (Spark semantics), and transform() on a predictor-ending pipeline
+    returns the feature matrix."""
+    X_tr, y_tr, X_te, _ = split(*cpusmall)
+    fitted_tree = DecisionTreeRegressor(max_depth=3).fit(X_tr, y_tr)
+    pm = Pipeline(stages=[fitted_tree]).fit(X_tr[:100], y_tr[:100] * 0.0)
+    np.testing.assert_allclose(
+        np.asarray(pm.predict(X_te)), np.asarray(fitted_tree.predict(X_te)), rtol=1e-6
+    )
+    # predictor-final pipeline: transform applies the feature stages only
+    pm2 = Pipeline(
+        stages=[StandardScaler(), GBMRegressor(num_base_learners=2)]
+    ).fit(X_tr[:500], y_tr[:500])
+    feats = np.asarray(pm2.transform(X_te[:50]))
+    assert feats.shape == X_te[:50].shape
+    # and a fitted pipeline nests as a stage of another pipeline
+    outer = Pipeline(stages=[pm2.stage_models[0], DecisionTreeRegressor(max_depth=2)])
+    outer_model = outer.fit(X_tr[:500], y_tr[:500])
+    assert np.asarray(outer_model.predict(X_te[:50])).shape == (50,)
+
+
+def test_cv_model_with_estimator_grid_saves(tmp_path, cpusmall):
+    """A grid sweeping estimator-valued params must not break save()."""
+    X_tr, y_tr, _, _ = split(*cpusmall)
+    grid = [
+        {"base_learner": DecisionTreeRegressor(max_depth=2)},
+        {"base_learner": DecisionTreeRegressor(max_depth=5)},
+    ]
+    tvs = TrainValidationSplit(
+        estimator=GBMRegressor(num_base_learners=2, learning_rate=0.5),
+        estimator_param_maps=grid,
+        evaluator=RegressionEvaluator(metric="rmse"),
+        seed=0,
+    )
+    model = tvs.fit(X_tr[:1500], y_tr[:1500])
+    path = str(tmp_path / "tvs")
+    model.save(path)
+    loaded = load(path)
+    np.testing.assert_allclose(
+        np.asarray(model.predict(X_tr[:50])),
+        np.asarray(loaded.predict(X_tr[:50])),
+        rtol=1e-5,
+    )
+
+
+def test_pipeline_save_load(tmp_path, cpusmall):
+    X_tr, y_tr, X_te, _ = split(*cpusmall)
+    pipe = Pipeline(
+        stages=[StandardScaler(), GBMRegressor(num_base_learners=5, learning_rate=0.3)]
+    )
+    model = pipe.fit(X_tr, y_tr)
+    path = str(tmp_path / "pipe")
+    model.save(path)
+    loaded = load(path)
+    np.testing.assert_allclose(
+        np.asarray(model.predict(X_te)), np.asarray(loaded.predict(X_te)), rtol=1e-5
+    )
