@@ -30,6 +30,7 @@ from spark_ensemble_tpu.models.base import (
     Estimator,
     RegressionModel,
     as_f32,
+    cached_program,
     infer_num_classes,
     resolve_weights,
 )
@@ -79,16 +80,20 @@ class BaggingRegressor(_BaggingParams):
         X, y = as_f32(X), as_f32(y)
         w = resolve_weights(y, sample_weight)
         n, d = X.shape
-        base = self._base()
+        # snapshot the base learner: cached round-step closures must not
+        # observe later set_params mutations of the caller's instance
+        base = self._base().copy()
         ctx = base.make_fit_ctx(X)
         fit_w, masks, keys = self._member_plan(n, d, w)
-        fit_all = jax.jit(
-            jax.vmap(
-                lambda fw, m, k: base.fit_from_ctx(ctx, y, fw, m, k),
-                in_axes=(0, 0, 0),
-            )
+        fit_all = cached_program(
+            ("bagging_fit", base.config_key()),
+            lambda: jax.jit(
+                lambda ctx, y, fit_w, masks, keys: jax.vmap(
+                    lambda fw, m, k: base.fit_from_ctx(ctx, y, fw, m, k)
+                )(fit_w, masks, keys)
+            ),
         )
-        members = fit_all(fit_w, masks, keys)
+        members = fit_all(ctx, y, fit_w, masks, keys)
         return BaggingRegressionModel(
             params={"members": members, "masks": masks},
             num_features=d,
@@ -122,16 +127,20 @@ class BaggingClassifier(_BaggingParams):
         w = resolve_weights(y, sample_weight)
         num_classes = infer_num_classes(y)
         n, d = X.shape
-        base = self._base()
+        # snapshot the base learner: cached round-step closures must not
+        # observe later set_params mutations of the caller's instance
+        base = self._base().copy()
         ctx = base.make_fit_ctx(X, num_classes)
         fit_w, masks, keys = self._member_plan(n, d, w)
-        fit_all = jax.jit(
-            jax.vmap(
-                lambda fw, m, k: base.fit_from_ctx(ctx, y, fw, m, k),
-                in_axes=(0, 0, 0),
-            )
+        fit_all = cached_program(
+            ("bagging_fit_cls", base.config_key(), num_classes),
+            lambda: jax.jit(
+                lambda ctx, y, fit_w, masks, keys: jax.vmap(
+                    lambda fw, m, k: base.fit_from_ctx(ctx, y, fw, m, k)
+                )(fit_w, masks, keys)
+            ),
         )
-        members = fit_all(fit_w, masks, keys)
+        members = fit_all(ctx, y, fit_w, masks, keys)
         return BaggingClassificationModel(
             params={"members": members, "masks": masks},
             num_features=d,

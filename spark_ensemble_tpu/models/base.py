@@ -30,7 +30,8 @@ drop weights, like `StackingClassifier.scala:147-150`.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from collections import OrderedDict
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +42,59 @@ from spark_ensemble_tpu.params import Params
 
 def as_f32(x) -> jax.Array:
     return jnp.asarray(x, dtype=jnp.float32)
+
+
+@jax.tree_util.register_static
+class Static:
+    """Wrap a hashable value so it rides a pytree (e.g. a fit ctx passed as
+    a jit argument) as STATIC treedef data rather than a traced leaf.  Used
+    for ctx fields like ``num_classes`` that shape the traced program."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def __hash__(self):
+        return hash(self.value)
+
+    def __eq__(self, other):
+        return isinstance(other, Static) and self.value == other.value
+
+    def __repr__(self):
+        return f"Static({self.value!r})"
+
+
+def static_value(v):
+    """Unwrap ``Static`` (pass plain values through, for back-compat)."""
+    return v.value if isinstance(v, Static) else v
+
+
+# Process-wide cache of jitted training programs, keyed by estimator/base
+# config fingerprints (`Params.config_key`).  Estimator `fit` methods build
+# their round-step closures over *config only* (all data flows through
+# arguments) and register them here, so a second fit with the same config —
+# another estimator instance, a CV fold, a bench run after warmup — reuses
+# the compiled XLA program instead of retracing.  LRU-bounded: compiled
+# programs hold device buffers for constants.
+_PROGRAM_CACHE: "OrderedDict[tuple, Callable]" = OrderedDict()
+_PROGRAM_CACHE_SIZE = 128
+
+
+def cached_program(key: tuple, build: Callable[[], Callable]) -> Callable:
+    """Return the jitted program for ``key``, building it on first use.
+
+    ``build`` must return an already-jitted callable whose trace depends
+    only on information captured in ``key`` (plus argument shapes/dtypes,
+    which jax.jit handles itself).
+    """
+    fn = _PROGRAM_CACHE.get(key)
+    if fn is None:
+        fn = build()
+        _PROGRAM_CACHE[key] = fn
+        while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_SIZE:
+            _PROGRAM_CACHE.popitem(last=False)
+    else:
+        _PROGRAM_CACHE.move_to_end(key)
+    return fn
 
 
 def resolve_weights(y: jax.Array, sample_weight) -> jax.Array:

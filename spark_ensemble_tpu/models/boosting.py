@@ -47,6 +47,7 @@ from spark_ensemble_tpu.models.base import (
     Estimator,
     RegressionModel,
     as_f32,
+    cached_program,
     infer_num_classes,
     resolve_weights,
 )
@@ -90,47 +91,56 @@ class BoostingClassifier(_BoostingParams):
         instr = Instrumentation("BoostingClassifier.fit")
         instr.log_params(self.get_params())
         instr.log_dataset(n, d, num_classes)
-        base = self._base()
+        # snapshot the base learner: cached round-step closures must not
+        # observe later set_params mutations of the caller's instance
+        base = self._base().copy()
         ctx = base.make_fit_ctx(X, num_classes)
         algorithm = self.algorithm.lower()
         k = num_classes
         root = jax.random.PRNGKey(self.seed)
 
-        def round_discrete(bw, key):
-            w_norm = bw / jnp.maximum(jnp.sum(bw), 1e-30)
-            params = base.fit_from_ctx(ctx, y, w_norm, None, key)
-            miss = (base.predict_fn(params, X) != y).astype(jnp.float32)
-            err = jnp.sum(w_norm * miss)
-            beta = err / jnp.maximum((1.0 - err) * (k - 1.0), 1e-30)
-            est_weight = jnp.where(beta == 0.0, 1.0, jnp.log(1.0 / jnp.maximum(beta, 1e-300)))
-            new_bw = w_norm * jnp.power(
-                1.0 / jnp.maximum(beta, 1e-300), miss
-            )
-            return params, err, est_weight, new_bw
+        def build_step():
+            def round_discrete(ctx, X, y, bw, key):
+                w_norm = bw / jnp.maximum(jnp.sum(bw), 1e-30)
+                params = base.fit_from_ctx(ctx, y, w_norm, None, key)
+                miss = (base.predict_fn(params, X) != y).astype(jnp.float32)
+                err = jnp.sum(w_norm * miss)
+                beta = err / jnp.maximum((1.0 - err) * (k - 1.0), 1e-30)
+                est_weight = jnp.where(
+                    beta == 0.0, 1.0, jnp.log(1.0 / jnp.maximum(beta, 1e-300))
+                )
+                new_bw = w_norm * jnp.power(1.0 / jnp.maximum(beta, 1e-300), miss)
+                return params, err, est_weight, new_bw
 
-        def round_real(bw, key):
-            w_norm = bw / jnp.maximum(jnp.sum(bw), 1e-30)
-            params = base.fit_from_ctx(ctx, y, w_norm, None, key)
-            proba = base.predict_proba_fn(params, X)  # [n, k]
-            miss = (jnp.argmax(proba, axis=-1) != y.astype(jnp.int32)).astype(
-                jnp.float32
-            )
-            err = jnp.sum(w_norm * miss)
-            codes = jnp.where(
-                jax.nn.one_hot(y.astype(jnp.int32), k) > 0, 1.0, -1.0 / (k - 1.0)
-            )
-            ll = jnp.sum(codes * jnp.log(jnp.maximum(proba, EPSILON)), axis=-1)
-            new_bw = w_norm * jnp.exp(-((k - 1.0) / k) * ll)
-            return params, err, jnp.asarray(1.0, jnp.float32), new_bw
+            def round_real(ctx, X, y, bw, key):
+                w_norm = bw / jnp.maximum(jnp.sum(bw), 1e-30)
+                params = base.fit_from_ctx(ctx, y, w_norm, None, key)
+                proba = base.predict_proba_fn(params, X)  # [n, k]
+                miss = (jnp.argmax(proba, axis=-1) != y.astype(jnp.int32)).astype(
+                    jnp.float32
+                )
+                err = jnp.sum(w_norm * miss)
+                codes = jnp.where(
+                    jax.nn.one_hot(y.astype(jnp.int32), k) > 0, 1.0, -1.0 / (k - 1.0)
+                )
+                ll = jnp.sum(codes * jnp.log(jnp.maximum(proba, EPSILON)), axis=-1)
+                new_bw = w_norm * jnp.exp(-((k - 1.0) / k) * ll)
+                return params, err, jnp.asarray(1.0, jnp.float32), new_bw
 
-        step = jax.jit(round_real if algorithm == "real" else round_discrete)
+            return jax.jit(round_real if algorithm == "real" else round_discrete)
+
+        step = cached_program(
+            ("boosting_cls_round", algorithm, k, base.config_key()), build_step
+        )
 
         bw = w
         members: List[Any] = []
         est_weights: List[float] = []
         i = 0
         while i < self.num_base_learners and float(jnp.sum(bw)) > 0:
-            params, err, est_weight, new_bw = step(bw, jax.random.fold_in(root, i))
+            params, err, est_weight, new_bw = step(
+                ctx, X, y, bw, jax.random.fold_in(root, i)
+            )
             err = float(err)
             if algorithm == "discrete" and err >= 1.0 - 1.0 / k:
                 # abort round, drop model (`BoostingClassifier.scala:252`)
@@ -231,27 +241,36 @@ class BoostingRegressor(_BoostingParams):
         instr = Instrumentation("BoostingRegressor.fit")
         instr.log_params(self.get_params())
         instr.log_dataset(n, d)
-        base = self._base()
+        # snapshot the base learner: cached round-step closures must not
+        # observe later set_params mutations of the caller's instance
+        base = self._base().copy()
         ctx = base.make_fit_ctx(X)
         root = jax.random.PRNGKey(self.seed)
 
-        def step(bw, key):
-            w_norm = bw / jnp.maximum(jnp.sum(bw), 1e-30)
-            params = base.fit_from_ctx(ctx, y, w_norm, None, key)
-            errors = jnp.abs(y - base.predict_fn(params, X))
-            max_error = jnp.max(errors)
-            rel = jnp.where(max_error > 0, errors / jnp.maximum(max_error, 1e-30), errors)
-            losses = self._shape_loss(rel)
-            est_err = jnp.sum(w_norm * losses)
-            beta = est_err / jnp.maximum(1.0 - est_err, 1e-30)
-            est_weight = jnp.where(
-                beta == 0.0, 1.0, jnp.log(1.0 / jnp.maximum(beta, 1e-300))
-            )
-            new_bw = w_norm * jnp.power(jnp.maximum(beta, 1e-300), 1.0 - losses)
-            new_bw = jnp.where(beta == 0.0, jnp.zeros_like(new_bw), new_bw)
-            return params, max_error, est_err, est_weight, new_bw
+        def build_step():
+            def step(ctx, X, y, bw, key):
+                w_norm = bw / jnp.maximum(jnp.sum(bw), 1e-30)
+                params = base.fit_from_ctx(ctx, y, w_norm, None, key)
+                errors = jnp.abs(y - base.predict_fn(params, X))
+                max_error = jnp.max(errors)
+                rel = jnp.where(
+                    max_error > 0, errors / jnp.maximum(max_error, 1e-30), errors
+                )
+                losses = self._shape_loss(rel)
+                est_err = jnp.sum(w_norm * losses)
+                beta = est_err / jnp.maximum(1.0 - est_err, 1e-30)
+                est_weight = jnp.where(
+                    beta == 0.0, 1.0, jnp.log(1.0 / jnp.maximum(beta, 1e-300))
+                )
+                new_bw = w_norm * jnp.power(jnp.maximum(beta, 1e-300), 1.0 - losses)
+                new_bw = jnp.where(beta == 0.0, jnp.zeros_like(new_bw), new_bw)
+                return params, max_error, est_err, est_weight, new_bw
 
-        step = jax.jit(step)
+            return jax.jit(step)
+
+        step = cached_program(
+            ("boosting_reg_round", self.loss.lower(), base.config_key()), build_step
+        )
 
         bw = w
         members: List[Any] = []
@@ -259,7 +278,7 @@ class BoostingRegressor(_BoostingParams):
         i = 0
         while i < self.num_base_learners and float(jnp.sum(bw)) > 0:
             params, max_error, est_err, est_weight, new_bw = step(
-                bw, jax.random.fold_in(root, i)
+                ctx, X, y, bw, jax.random.fold_in(root, i)
             )
             est_err = float(est_err)
             if float(max_error) == 0.0:

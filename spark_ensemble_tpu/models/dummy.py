@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 
 from spark_ensemble_tpu.models.base import (
+    Static,
+    static_value,
     BaseLearner,
     ClassificationModel,
     RegressionModel,
@@ -71,10 +73,10 @@ class DummyClassifier(BaseLearner):
     is_classifier = True
 
     def make_fit_ctx(self, X, num_classes=None):
-        return {"num_classes": num_classes}
+        return {"num_classes": Static(num_classes)}
 
     def fit_from_ctx(self, ctx, y, w, feature_mask, key):
-        k = ctx["num_classes"]
+        k = static_value(ctx["num_classes"])
         strategy = self.strategy.lower()
         if strategy == "uniform":
             proba = jnp.full((k,), 1.0 / k, jnp.float32)

@@ -49,6 +49,7 @@ from spark_ensemble_tpu.models.base import (
     Estimator,
     RegressionModel,
     as_f32,
+    cached_program,
     infer_num_classes,
     resolve_weights,
 )
@@ -216,7 +217,9 @@ class GBMRegressor(_GBMParams):
         instr = Instrumentation("GBMRegressor.fit")
         instr.log_params(self.get_params())
         instr.log_dataset(n, d)
-        base = self._base()
+        # snapshot the base learner: cached round-step closures must not
+        # observe later set_params mutations of the caller's instance
+        base = self._base().copy()
         ctx = base.make_fit_ctx(X)
         bag_keys, masks = self._sampling_plan(n, d)
 
@@ -238,41 +241,90 @@ class GBMRegressor(_GBMParams):
         repl = bool(self.replacement)
         tol = float(self.tol)
         max_iter = int(self.max_iter)
+        alpha_q = float(self.alpha)
+        loss_name = self.loss.lower()
+        base_key = base.config_key()
 
-        def round_step(bag_key, mask, pred, delta, y, w):
-            loss = self._make_loss(delta)
-            y_enc = loss.encode_label(y)
-            bag_w = bootstrap_weights(bag_key, y.shape[0], repl, sub_ratio)
-            labels, fit_w = _pseudo_residuals_and_weights(
-                loss, updates, y_enc, pred[:, None], bag_w, w
+        def make_loss(delta):
+            # local snapshot of _make_loss: cached closures must not read
+            # `self` at (re)trace time — set_params after fit would corrupt
+            # a retrace under the original cache key
+            if loss_name == "huber":
+                return losses_mod.HuberLoss(delta)
+            return losses_mod.get_regression_loss(
+                loss_name, alpha=alpha_q, quantile=alpha_q
             )
-            params = base.fit_from_ctx(ctx, labels[:, 0], fit_w[:, 0], mask, bag_key)
-            direction = base.predict_fn(params, X)
-            if optimized:
-                def phi(a):
-                    # bag-multiplicity weighting only (`GBMLoss.scala:50-74`)
-                    return jnp.sum(
-                        bag_w * loss.loss(y_enc, (pred + a * direction)[:, None])
+
+        # all data flows through arguments so the jitted programs are
+        # reusable across fits with the same config (no per-fit retrace)
+        def build_round_step():
+            def round_step(ctx, X, bag_key, mask, pred, delta, y, w):
+                loss = make_loss(delta)
+                y_enc = loss.encode_label(y)
+                bag_w = bootstrap_weights(bag_key, y.shape[0], repl, sub_ratio)
+                labels, fit_w = _pseudo_residuals_and_weights(
+                    loss, updates, y_enc, pred[:, None], bag_w, w
+                )
+                params = base.fit_from_ctx(
+                    ctx, labels[:, 0], fit_w[:, 0], mask, bag_key
+                )
+                direction = base.predict_fn(params, X)
+                if optimized:
+                    def phi(a):
+                        # bag-multiplicity weighting only (`GBMLoss.scala:50-74`)
+                        return jnp.sum(
+                            bag_w * loss.loss(y_enc, (pred + a * direction)[:, None])
+                        )
+                    alpha_opt = brent_minimize(
+                        phi, 0.0, 100.0, tol=tol, max_iter=max_iter
                     )
-                alpha_opt = brent_minimize(phi, 0.0, 100.0, tol=tol, max_iter=max_iter)
-            else:
-                alpha_opt = jnp.asarray(1.0, jnp.float32)
-            weight = lr * alpha_opt
-            new_pred = pred + weight * direction
-            return params, weight, new_pred
+                else:
+                    alpha_opt = jnp.asarray(1.0, jnp.float32)
+                weight = lr * alpha_opt
+                new_pred = pred + weight * direction
+                return params, weight, new_pred
 
-        round_step = jax.jit(round_step)
+            return jax.jit(round_step)
 
-        def eval_loss(pred_v, delta, y_v):
-            loss = self._make_loss(delta)
-            return jnp.mean(loss.loss(loss.encode_label(y_v), pred_v[:, None]))
+        round_step = cached_program(
+            (
+                "gbm_reg_round",
+                loss_name,
+                alpha_q,
+                updates,
+                optimized,
+                lr,
+                sub_ratio,
+                repl,
+                tol,
+                max_iter,
+                base_key,
+            ),
+            build_round_step,
+        )
 
-        eval_loss = jax.jit(eval_loss)
+        eval_loss = cached_program(
+            ("gbm_reg_eval", loss_name, alpha_q),
+            lambda: jax.jit(
+                lambda pred_v, delta, y_v: jnp.mean(
+                    self._make_loss(delta).loss(
+                        self._make_loss(delta).encode_label(y_v), pred_v[:, None]
+                    )
+                )
+            ),
+        )
 
-        def huber_delta(pred, y):
-            return weighted_quantile(jnp.abs(y - pred), self.alpha)
+        huber_delta = cached_program(
+            ("gbm_reg_hdelta", alpha_q),
+            lambda: jax.jit(
+                lambda pred, y: weighted_quantile(jnp.abs(y - pred), alpha_q)
+            ),
+        )
 
-        huber_delta = jax.jit(huber_delta)
+        predict_member = cached_program(
+            ("gbm_predict_member", base_key),
+            lambda: jax.jit(base.predict_fn),
+        )
 
         with_validation = X_val is not None
         best = 0.0
@@ -310,11 +362,13 @@ class GBMRegressor(_GBMParams):
         while i < self.num_base_learners and v < self.num_rounds:
             if huber:
                 delta = huber_delta(pred, y)
-            params, weight, pred = round_step(bag_keys[i], masks[i], pred, delta, y, w)
+            params, weight, pred = round_step(
+                ctx, X, bag_keys[i], masks[i], pred, delta, y, w
+            )
             members.append(params)
             weights.append(weight)
             if with_validation:
-                direction_val = base.predict_fn(params, X_val)
+                direction_val = predict_member(params, X_val)
                 pred_val = pred_val + weight * direction_val
                 err = float(eval_loss(pred_val, delta, y_val))
                 best, v = self._patience_step(best, err, v, self.validation_tol)
@@ -422,7 +476,9 @@ class GBMClassifier(_GBMParams):
         instr = Instrumentation("GBMClassifier.fit")
         instr.log_params(self.get_params())
         instr.log_dataset(n, d, num_classes)
-        base = self._base()
+        # snapshot the base learner: cached round-step closures must not
+        # observe later set_params mutations of the caller's instance
+        base = self._base().copy()
         ctx = base.make_fit_ctx(X)
         bag_keys, masks = self._sampling_plan(n, d)
         loss = self._make_loss(num_classes)
@@ -449,36 +505,66 @@ class GBMClassifier(_GBMParams):
         repl = bool(self.replacement)
         tol = float(self.tol)
         max_iter = int(self.max_iter)
+        loss_name = self.loss.lower()
+        base_key = base.config_key()
 
         y_enc = loss.encode_label(y)
 
-        def round_step(bag_key, mask, pred):
-            bag_w = bootstrap_weights(bag_key, n, repl, sub_ratio)
-            labels, fit_w = _pseudo_residuals_and_weights(
-                loss, updates, y_enc, pred, bag_w, w
-            )
-            # class-dim vmap replaces the reference's per-dim Futures
-            fit_j = lambda lab, fw: base.fit_from_ctx(ctx, lab, fw, mask, bag_key)
-            params = jax.vmap(fit_j, in_axes=(1, 1))(labels, fit_w)
-            directions = jax.vmap(lambda p: base.predict_fn(p, X))(params).T  # [n, dim]
-            if optimized:
-                def phi(a):
-                    return jnp.sum(bag_w * loss.loss(y_enc, pred + a[None, :] * directions))
-                alpha_opt = projected_newton_box(
-                    phi, jnp.ones((dim,), jnp.float32), max_iter=min(max_iter, 25), tol=tol
+        def build_round_step():
+            def round_step(ctx, X, y_enc, w, bag_key, mask, pred):
+                bag_w = bootstrap_weights(bag_key, y_enc.shape[0], repl, sub_ratio)
+                labels, fit_w = _pseudo_residuals_and_weights(
+                    loss, updates, y_enc, pred, bag_w, w
                 )
-            else:
-                alpha_opt = jnp.ones((dim,), jnp.float32)
-            weight = lr * alpha_opt
-            new_pred = pred + weight[None, :] * directions
-            return params, weight, new_pred
+                # class-dim vmap replaces the reference's per-dim Futures
+                fit_j = lambda lab, fw: base.fit_from_ctx(ctx, lab, fw, mask, bag_key)
+                params = jax.vmap(fit_j, in_axes=(1, 1))(labels, fit_w)
+                directions = jax.vmap(lambda p: base.predict_fn(p, X))(params).T
+                if optimized:
+                    def phi(a):
+                        return jnp.sum(
+                            bag_w * loss.loss(y_enc, pred + a[None, :] * directions)
+                        )
+                    alpha_opt = projected_newton_box(
+                        phi,
+                        jnp.ones((dim,), jnp.float32),
+                        max_iter=min(max_iter, 25),
+                        tol=tol,
+                    )
+                else:
+                    alpha_opt = jnp.ones((dim,), jnp.float32)
+                weight = lr * alpha_opt
+                new_pred = pred + weight[None, :] * directions
+                return params, weight, new_pred
 
-        round_step = jax.jit(round_step)
+            return jax.jit(round_step)
 
-        def eval_loss(pred_v, y_enc_v):
-            return jnp.mean(loss.loss(y_enc_v, pred_v))
+        round_key = (
+            "gbm_cls_round",
+            loss_name,
+            num_classes,
+            updates,
+            optimized,
+            lr,
+            sub_ratio,
+            repl,
+            tol,
+            max_iter,
+            base_key,
+        )
+        round_step = cached_program(round_key, build_round_step)
 
-        eval_loss = jax.jit(eval_loss)
+        eval_loss = cached_program(
+            ("gbm_cls_eval", loss_name, num_classes),
+            lambda: jax.jit(lambda pred_v, y_enc_v: jnp.mean(loss.loss(y_enc_v, pred_v))),
+        )
+
+        member_dirs = cached_program(
+            ("gbm_cls_member_dirs", base_key),
+            lambda: jax.jit(
+                lambda params, Xq: jax.vmap(lambda p: base.predict_fn(p, Xq))(params).T
+            ),
+        )
 
         with_validation = X_val is not None
         best = 0.0
@@ -520,11 +606,13 @@ class GBMClassifier(_GBMParams):
             logger.info("GBMClassifier resuming from round %d", i)
 
         while i < self.num_base_learners and v < self.num_rounds:
-            params, weight, pred = round_step(bag_keys[i], masks[i], pred)
+            params, weight, pred = round_step(
+                ctx, X, y_enc, w, bag_keys[i], masks[i], pred
+            )
             members.append(params)
             weights.append(weight)
             if with_validation:
-                dirs_val = jax.vmap(lambda p: base.predict_fn(p, X_val))(params).T
+                dirs_val = member_dirs(params, X_val)
                 pred_val = pred_val + weight[None, :] * dirs_val
                 err = float(eval_loss(pred_val, y_enc_val))
                 best, v = self._patience_step(best, err, v, self.validation_tol)

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import optax
 
 from spark_ensemble_tpu.models.base import (
+    Static,
+    static_value,
     BaseLearner,
     ClassificationModel,
     RegressionModel,
@@ -132,11 +134,11 @@ class LogisticRegression(BaseLearner):
     is_classifier = True
 
     def make_fit_ctx(self, X, num_classes=None):
-        return {"X": as_f32(X), "num_classes": num_classes}
+        return {"X": as_f32(X), "num_classes": Static(num_classes)}
 
     def fit_from_ctx(self, ctx, y, w, feature_mask, key):
         X = _apply_mask(ctx["X"], feature_mask)
-        k = ctx["num_classes"]
+        k = static_value(ctx["num_classes"])
         n, d = X.shape
         mu, sd = _feature_stats(X, w)
         Xs = (X - mu[None, :]) / sd[None, :]

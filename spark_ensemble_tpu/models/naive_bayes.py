@@ -13,6 +13,8 @@ import jax
 import jax.numpy as jnp
 
 from spark_ensemble_tpu.models.base import (
+    Static,
+    static_value,
     BaseLearner,
     ClassificationModel,
     as_f32,
@@ -26,11 +28,11 @@ class GaussianNaiveBayes(BaseLearner):
     is_classifier = True
 
     def make_fit_ctx(self, X, num_classes=None):
-        return {"X": as_f32(X), "num_classes": num_classes}
+        return {"X": as_f32(X), "num_classes": Static(num_classes)}
 
     def fit_from_ctx(self, ctx, y, w, feature_mask, key):
         X = ctx["X"]
-        k = ctx["num_classes"]
+        k = static_value(ctx["num_classes"])
         d = X.shape[1]
         onehot = jax.nn.one_hot(y.astype(jnp.int32), k)  # [n, k]
         wc = onehot * w[:, None]  # [n, k]

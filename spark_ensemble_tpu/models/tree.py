@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 
 from spark_ensemble_tpu.models.base import (
+    Static,
+    static_value,
     BaseLearner,
     ClassificationModel,
     RegressionModel,
@@ -42,7 +44,7 @@ class _TreeLearner(BaseLearner):
         X = as_f32(X)
         bins = compute_bins(X, self.max_bins)
         Xb = bin_features(X, bins)
-        return {"Xb": Xb, "thresholds": bins.thresholds, "num_classes": num_classes}
+        return {"Xb": Xb, "thresholds": bins.thresholds, "num_classes": Static(num_classes)}
 
     def _targets(self, ctx, y) -> jax.Array:
         raise NotImplementedError
@@ -84,7 +86,7 @@ class DecisionTreeClassifier(_TreeLearner):
     is_classifier = True
 
     def _targets(self, ctx, y):
-        return jax.nn.one_hot(y.astype(jnp.int32), ctx["num_classes"])
+        return jax.nn.one_hot(y.astype(jnp.int32), static_value(ctx["num_classes"]))
 
     def predict_proba_fn(self, params: Tree, X):
         # leaf values are weighted one-hot means: a probability vector up to

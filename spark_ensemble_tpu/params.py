@@ -151,6 +151,26 @@ class Params:
         new.set_params(**extra)
         return new
 
+    def config_key(self) -> tuple:
+        """Hashable fingerprint of type + all params (nested estimators
+        recursively).  Two instances with equal keys trace to identical
+        XLA programs, so jitted train/predict programs can be cached and
+        shared across estimator instances (a per-``fit`` closure would
+        recompile every call)."""
+
+        def enc(v):
+            if isinstance(v, Params):
+                return v.config_key()
+            if isinstance(v, (list, tuple)):
+                return tuple(enc(x) for x in v)
+            if isinstance(v, dict):
+                return tuple(sorted((k, enc(x)) for k, x in v.items()))
+            return v
+
+        return (type(self).__name__,) + tuple(
+            (name, enc(getattr(self, name))) for name in self._param_names()
+        )
+
     # -- JSON metadata (estimator-valued params excluded) -------------------
     def params_to_json_dict(self) -> Dict[str, Any]:
         defs = self._param_defs()

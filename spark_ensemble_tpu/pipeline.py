@@ -77,7 +77,7 @@ class MinMaxScaler(Estimator):
         lo = jnp.min(X, axis=0)
         hi = jnp.max(X, axis=0)
         return MinMaxScalerModel(
-            params={"lo": lo, "range": jnp.maximum(hi - lo, 1e-12)},
+            params={"lo": lo, "range": hi - lo},
             num_features=X.shape[1],
             **self.get_params(),
         )
@@ -86,7 +86,12 @@ class MinMaxScaler(Estimator):
 class MinMaxScalerModel(Model, MinMaxScaler):
     def transform(self, X):
         X = as_f32(X)
-        unit = (X - self.params["lo"]) / self.params["range"]
+        rng = self.params["range"]
+        # constant columns rescale to the midpoint, matching Spark's
+        # E_max == E_min rule
+        unit = jnp.where(
+            rng > 0, (X - self.params["lo"]) / jnp.maximum(rng, 1e-30), 0.5
+        )
         return unit * (self.feature_max - self.feature_min) + self.feature_min
 
     def predict(self, X):
@@ -125,8 +130,15 @@ class Pipeline(Estimator):
                     Xc = model.transform(Xc)
             else:
                 raise TypeError(f"invalid pipeline stage {stage!r}")
+        # class count comes from the LAST stage that knows it (the final
+        # predictor); earlier transformer stages may carry num_classes=None
         num_classes = next(
-            (m.num_classes for m in fitted if hasattr(m, "num_classes")), None
+            (
+                m.num_classes
+                for m in reversed(fitted)
+                if getattr(m, "num_classes", None) is not None
+            ),
+            None,
         )
         return PipelineModel(
             stage_models=fitted,

@@ -156,3 +156,52 @@ def test_sharded_histogram_fit_matches_single_device():
     assert jnp.array_equal(sharded.split_feature, single.split_feature)
     assert jnp.array_equal(sharded.split_bin, single.split_bin)
     assert jnp.allclose(sharded.leaf_value, single.leaf_value, atol=1e-4)
+
+
+def test_hist_matmul_matches_scatter():
+    """The MXU one-hot-matmul histogram path must build the same tree as
+    the scatter path (same splits, same leaf values)."""
+    X, y = _data(n=1500, d=6, seed=3)
+    b = compute_bins(X, 32)
+    Xb = bin_features(X, b)
+    w = jnp.asarray(np.random.RandomState(0).rand(1500).astype(np.float32))
+    t_scatter = fit_tree(
+        Xb, y[:, None], w, b.thresholds, max_depth=4, max_bins=32, hist="scatter"
+    )
+    t_matmul = fit_tree(
+        Xb, y[:, None], w, b.thresholds, max_depth=4, max_bins=32, hist="matmul"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(t_scatter.split_feature), np.asarray(t_matmul.split_feature)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(t_scatter.split_bin), np.asarray(t_matmul.split_bin)
+    )
+    np.testing.assert_allclose(
+        np.asarray(t_scatter.leaf_value),
+        np.asarray(t_matmul.leaf_value),
+        rtol=1e-4,
+        atol=1e-4,
+    )
+
+
+def test_hist_matmul_multioutput_and_mask():
+    """Matmul path with k>1 targets and a feature mask (bagging-classifier
+    shape) matches scatter."""
+    rng = np.random.RandomState(1)
+    X = jnp.asarray(rng.randn(1000, 5).astype(np.float32))
+    ylab = rng.randint(0, 3, 1000)
+    Y = jnp.asarray(np.eye(3, dtype=np.float32)[ylab])
+    b = compute_bins(X, 16)
+    Xb = bin_features(X, b)
+    w = jnp.ones((1000,))
+    mask = jnp.asarray([True, True, False, True, False])
+    kw = dict(max_depth=3, max_bins=16)
+    t1 = fit_tree(Xb, Y, w, b.thresholds, mask, hist="scatter", **kw)
+    t2 = fit_tree(Xb, Y, w, b.thresholds, mask, hist="matmul", **kw)
+    np.testing.assert_array_equal(
+        np.asarray(t1.split_feature), np.asarray(t2.split_feature)
+    )
+    np.testing.assert_allclose(
+        np.asarray(t1.leaf_value), np.asarray(t2.leaf_value), rtol=1e-4, atol=1e-4
+    )
