@@ -2,20 +2,162 @@
 plus predict rows/sec — the primary metric pinned by BASELINE.json.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
-The reference publishes no numbers (BASELINE.md), so vs_baseline is measured
-against a conservative JVM-reference estimate recorded in this file once a
-reference timing exists; until then it reports 1.0 relative to itself.
+
+Resilience (this environment's TPU plugin init can hang indefinitely or
+error — it took down the round-1 bench): the parent process never touches
+jax.  It probes the accelerator in a SUBPROCESS with a timeout, retries with
+backoff, runs the measured bench in another subprocess (also bounded), and
+on any failure falls back to a CPU-pinned run — so a JSON line is always
+produced, carrying an "error" field when the accelerator was unreachable.
+
+vs_baseline: the reference publishes no numbers (BASELINE.md), so the
+baseline is the first driver-captured number of this project, recorded in
+_BASELINES below per device kind; 1.0 until one exists for the device.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+_REPO = os.path.dirname(os.path.abspath(__file__))
 
+# One stable metric name across accelerator / CPU-fallback / failure paths —
+# the round count varies per path and lives in the "num_rounds" field.
+_METRIC = "GBM boosting-iters/sec/chip (letter)"
+
+# First driver-captured iters/sec per device platform (see BASELINE.md).
+# vs_baseline for later rounds = measured / baseline on the same platform.
+_BASELINES = {
+    "cpu": None,  # filled from the first captured CPU number
+    "tpu": None,  # filled from the first captured TPU number
+}
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _cpu_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_FORCE_CPU"] = "1"
+    return env
+
+
+def _probe_accelerator(timeout_s):
+    """Check (in a subprocess, so a hang cannot take us down) that jax can
+    bring up the default backend."""
+    code = (
+        "import jax; ds = jax.devices(); "
+        "print(ds[0].platform, len(ds))"
+    )
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"backend init timed out after {timeout_s}s"
+    if p.returncode != 0:
+        return False, (p.stderr or p.stdout).strip()[-500:]
+    return True, p.stdout.strip()
+
+
+def _run_inner(env, timeout_s):
+    """Run the measured bench in a subprocess; return (json_dict | None, err)."""
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--inner"],
+            env=env,
+            cwd=_REPO,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"bench run timed out after {timeout_s}s"
+    for line in reversed((p.stdout or "").strip().splitlines()):
+        try:
+            return json.loads(line), None
+        except json.JSONDecodeError:
+            continue
+    return None, (p.stderr or p.stdout).strip()[-800:] or "no output"
+
+
+def main():
+    probe_timeout = _env_int("BENCH_PROBE_TIMEOUT", 240)
+    retries = _env_int("BENCH_PROBE_RETRIES", 2)
+    inner_timeout = _env_int("BENCH_TIMEOUT", 3600)
+
+    errors = []
+    ok = False
+    for attempt in range(retries):
+        ok, info = _probe_accelerator(probe_timeout)
+        if ok:
+            break
+        errors.append(f"probe {attempt + 1}: {info}")
+        if attempt + 1 < retries:
+            time.sleep(min(30 * (attempt + 1), 120))
+
+    if ok:
+        result, err = _run_inner(dict(os.environ), inner_timeout)
+        if result is None:
+            errors.append(f"accelerator bench: {err}")
+        else:
+            result["value"] = result.get("value", 0.0)
+            # a green accelerator run is not degraded: earlier probe
+            # failures are warnings, not errors
+            _finish(result, [], warnings=errors)
+            return 0
+
+    # CPU fallback: fewer rounds (same metric — iters/sec), error carried
+    env = _cpu_env()
+    env.setdefault("BENCH_ROUNDS", os.environ.get("BENCH_ROUNDS_CPU", "20"))
+    result, err = _run_inner(env, inner_timeout)
+    if result is None:
+        errors.append(f"cpu fallback: {err}")
+        _finish(
+            {
+                "metric": _METRIC,
+                "value": 0.0,
+                "unit": "iters/sec",
+                "vs_baseline": 0.0,
+            },
+            errors,
+        )
+        return 1
+    _finish(result, errors)
+    return 0
+
+
+def _finish(result, errors, warnings=None):
+    if errors:
+        result["error"] = "; ".join(errors)[-1000:]
+    if warnings:
+        result["warnings"] = "; ".join(warnings)[-1000:]
+    platform = result.get("platform", "cpu")
+    base = _BASELINES.get(platform)
+    if base and result.get("value"):
+        result["vs_baseline"] = round(result["value"] / base, 3)
+    else:
+        result.setdefault("vs_baseline", 1.0)
+    print(json.dumps(result))
+
+
+# ---------------------------------------------------------------------------
+# inner: the actual measurement (runs in a subprocess the parent bounds)
+# ---------------------------------------------------------------------------
 
 def _load_letter():
+    import numpy as np
+
     from spark_ensemble_tpu.utils.datasets import has_reference_data, load_dataset
 
     if has_reference_data():
@@ -29,13 +171,30 @@ def _load_letter():
     return X, y
 
 
-def main():
+def _flops_per_round(n, d, k, max_depth, max_bins):
+    """FLOP estimate for one GBM round, matmul-histogram path: per level,
+    H = A^T[nodes*(1+1), n] @ bin_oh[n, d*B] per class dim, plus leaf pass."""
+    per_tree = sum(
+        2.0 * n * (2**level * 2) * (d * max_bins)
+        for level in range(max_depth)
+    ) + 2.0 * n * (2**max_depth * 2)
+    return per_tree * k
+
+
+def inner():
+    import numpy as np
+
     import jax
+
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        # env var alone is NOT enough here: a site hook force-registers the
+        # accelerator plugin; the config update pins the platform for real
+        jax.config.update("jax_platforms", "cpu")
 
     from spark_ensemble_tpu import GBMClassifier
 
     X, y = _load_letter()
-    num_rounds = int(os.environ.get("BENCH_ROUNDS", "100"))
+    num_rounds = _env_int("BENCH_ROUNDS", 100)
 
     est = GBMClassifier(
         num_base_learners=num_rounds,
@@ -45,7 +204,7 @@ def main():
         optimized_weights=True,
     )
 
-    # warmup: compile the round step on a small prefix (cached for full run)
+    # warmup: compile the round step on one round (cached for the full run)
     warm = GBMClassifier(
         num_base_learners=1, loss="logloss", updates="newton", learning_rate=0.3
     )
@@ -56,7 +215,7 @@ def main():
     fit_s = time.perf_counter() - t0
     iters_per_sec = num_rounds / fit_s
 
-    # predict throughput (raw scores; jitted, steady-state)
+    # predict throughput (argmax path; jitted, steady-state)
     Xd = jax.numpy.asarray(X)
     jax.block_until_ready(model.predict(Xd))  # compile at the timed shape
     t0 = time.perf_counter()
@@ -69,10 +228,16 @@ def main():
 
     train_acc = float(np.mean(np.asarray(model.predict(Xd)) == y))
 
+    flops = _flops_per_round(X.shape[0], X.shape[1], 26, 5, 64)
+    platform = jax.devices()[0].platform
+    # chip peak (dense f32/bf16 mixed); v5e ~197e12 bf16 — rough roofline
+    peak = 197e12 if platform != "cpu" else 1e12
+    mfu = flops * iters_per_sec / peak
+
     print(
         json.dumps(
             {
-                "metric": "GBM boosting-iters/sec/chip (letter, 100 rounds)",
+                "metric": _METRIC,
                 "value": round(iters_per_sec, 3),
                 "unit": "iters/sec",
                 "vs_baseline": 1.0,
@@ -80,6 +245,9 @@ def main():
                 "fit_seconds": round(fit_s, 2),
                 "train_accuracy": round(train_acc, 4),
                 "num_rounds": num_rounds,
+                "flops_per_round_est": flops,
+                "mfu_est": round(mfu, 5),
+                "platform": platform,
                 "device": str(jax.devices()[0]),
             }
         )
@@ -87,4 +255,7 @@ def main():
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--inner":
+        inner()
+        sys.exit(0)
     sys.exit(main())

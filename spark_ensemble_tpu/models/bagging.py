@@ -23,6 +23,7 @@ from typing import Any, List, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_ensemble_tpu.models.base import (
     BaseLearner,
@@ -69,6 +70,38 @@ class _BaggingParams(Estimator):
         fit_w, masks = jax.vmap(plan)(keys)
         return fit_w, masks, keys
 
+    @staticmethod
+    def _shard_members(mesh: Mesh, ctx, y, fit_w, masks, keys):
+        """Shard the member axis over ALL mesh devices and replicate the
+        shared data — the TPU mapping of the reference's driver thread-pool
+        member parallelism (`BaggingClassifier.scala:180-201`,
+        `parallel/mesh.py` member axis).  The same vmapped fit program is
+        then auto-partitioned by XLA along the member axis, so every device
+        trains its own block of members and the fitted forest stays sharded
+        across devices.  A member count that does not divide the device
+        count is padded with zero-weight phantom members (trimmed by the
+        caller); phantom fits are all-zero-weight degenerate models that
+        cost one extra member slot per device at most."""
+        n_dev = mesh.devices.size
+        m = fit_w.shape[0]
+        m_pad = m + (-m) % n_dev
+        if m_pad != m:
+            pad = [(0, m_pad - m)]
+            fit_w = jnp.pad(fit_w, pad + [(0, 0)])
+            masks = jnp.pad(masks, pad + [(0, 0)], constant_values=True)
+            keys = jnp.pad(keys, pad + [(0, 0)] * (keys.ndim - 1))
+        member = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+        rep = NamedSharding(mesh, P())
+        ctx = jax.device_put(ctx, jax.tree_util.tree_map(lambda _: rep, ctx))
+        y = jax.device_put(y, rep)
+        return (
+            ctx,
+            y,
+            jax.device_put(fit_w, member),
+            jax.device_put(masks, member),
+            jax.device_put(keys, member),
+        )
+
 
 class BaggingRegressor(_BaggingParams):
     is_classifier = False
@@ -76,7 +109,7 @@ class BaggingRegressor(_BaggingParams):
     def _base(self) -> BaseLearner:
         return self.base_learner or DecisionTreeRegressor()
 
-    def fit(self, X, y, sample_weight=None) -> "BaggingRegressionModel":
+    def fit(self, X, y, sample_weight=None, mesh=None) -> "BaggingRegressionModel":
         X, y = as_f32(X), as_f32(y)
         w = resolve_weights(y, sample_weight)
         n, d = X.shape
@@ -85,6 +118,11 @@ class BaggingRegressor(_BaggingParams):
         base = self._base().copy()
         ctx = base.make_fit_ctx(X)
         fit_w, masks, keys = self._member_plan(n, d, w)
+        member_masks = masks
+        if mesh is not None:
+            ctx, y, fit_w, masks, keys = self._shard_members(
+                mesh, ctx, y, fit_w, masks, keys
+            )
         fit_all = cached_program(
             ("bagging_fit", base.config_key()),
             lambda: jax.jit(
@@ -94,8 +132,11 @@ class BaggingRegressor(_BaggingParams):
             ),
         )
         members = fit_all(ctx, y, fit_w, masks, keys)
+        members = jax.tree_util.tree_map(
+            lambda x: x[: self.num_base_learners], members
+        )
         return BaggingRegressionModel(
-            params={"members": members, "masks": masks},
+            params={"members": members, "masks": member_masks},
             num_features=d,
             **self.get_params(),
         )
@@ -122,7 +163,7 @@ class BaggingClassifier(_BaggingParams):
     def _base(self) -> BaseLearner:
         return self.base_learner or DecisionTreeClassifier()
 
-    def fit(self, X, y, sample_weight=None) -> "BaggingClassificationModel":
+    def fit(self, X, y, sample_weight=None, mesh=None) -> "BaggingClassificationModel":
         X, y = as_f32(X), as_f32(y)
         w = resolve_weights(y, sample_weight)
         num_classes = infer_num_classes(y)
@@ -132,6 +173,11 @@ class BaggingClassifier(_BaggingParams):
         base = self._base().copy()
         ctx = base.make_fit_ctx(X, num_classes)
         fit_w, masks, keys = self._member_plan(n, d, w)
+        member_masks = masks
+        if mesh is not None:
+            ctx, y, fit_w, masks, keys = self._shard_members(
+                mesh, ctx, y, fit_w, masks, keys
+            )
         fit_all = cached_program(
             ("bagging_fit_cls", base.config_key(), num_classes),
             lambda: jax.jit(
@@ -141,8 +187,11 @@ class BaggingClassifier(_BaggingParams):
             ),
         )
         members = fit_all(ctx, y, fit_w, masks, keys)
+        members = jax.tree_util.tree_map(
+            lambda x: x[: self.num_base_learners], members
+        )
         return BaggingClassificationModel(
-            params={"members": members, "masks": masks},
+            params={"members": members, "masks": member_masks},
             num_features=d,
             num_classes=num_classes,
             **self.get_params(),

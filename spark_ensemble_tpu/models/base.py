@@ -185,9 +185,28 @@ class BaseLearner(Estimator):
         w: jax.Array,
         feature_mask: Optional[jax.Array],
         key: jax.Array,
+        axis_name: Optional[str] = None,
     ) -> Any:
-        """Pure, jittable, vmappable member fit -> params pytree."""
+        """Pure, jittable, vmappable member fit -> params pytree.
+
+        ``axis_name`` names the mesh data axis when the fit runs inside
+        ``shard_map`` with rows sharded across devices: the learner must
+        ``psum`` its sufficient statistics over that axis so every shard
+        computes the identical global model — the SPMD analogue of the
+        reference's executors aggregating per-partition statistics with
+        ``treeAggregate`` (`GBMClassifier.scala:344-355`).
+        """
         raise NotImplementedError
+
+    def ctx_specs(self, ctx: Any, data_axis: str):
+        """``shard_map`` PartitionSpecs for the fit ctx under row sharding:
+        row-indexed leaves sharded over ``data_axis``, the rest replicated.
+        The default ctx is the feature matrix itself, sharded on axis 0."""
+        from jax.sharding import PartitionSpec as P
+
+        return jax.tree_util.tree_map(
+            lambda leaf: P(data_axis, *([None] * (jnp.ndim(leaf) - 1))), ctx
+        )
 
     def predict_fn(self, params: Any, X: jax.Array) -> jax.Array:
         """Regression value [n] (regressors) or class index f32[n] (classifiers)."""

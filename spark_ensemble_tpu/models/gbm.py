@@ -42,6 +42,8 @@ from typing import Any, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_ensemble_tpu.models.base import (
     BaseLearner,
@@ -70,6 +72,41 @@ logger = logging.getLogger(__name__)
 
 def stack_pytrees(trees: List[Any]):
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def _pad_rows(arr, n_pad: int):
+    """Zero-pad axis 0 to ``n_pad`` rows (padding rows carry weight 0
+    downstream, so statistics are unchanged)."""
+    rem = n_pad - arr.shape[0]
+    if rem == 0:
+        return arr
+    return jnp.pad(arr, [(0, rem)] + [(0, 0)] * (arr.ndim - 1))
+
+
+def _pad_ctx_rows(ctx, specs, n_pad: int, data_axis: str = "data"):
+    """Pad every row-indexed ctx leaf (per its shard spec) to ``n_pad``."""
+
+    def pad(leaf, spec):
+        if len(spec) > 0 and spec[0] == data_axis:
+            return _pad_rows(leaf, n_pad)
+        return leaf
+
+    return jax.tree_util.tree_map(pad, ctx, specs)
+
+
+def _shard_put(tree, specs, mesh: Mesh):
+    """device_put a pytree with NamedShardings built from its spec pytree."""
+    shardings = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs)
+    return jax.device_put(tree, shardings)
+
+
+def _mesh_sizes(mesh: Mesh):
+    if "data" not in mesh.axis_names:
+        raise ValueError(
+            f"mesh must have a 'data' axis; got axes {mesh.axis_names}"
+        )
+    member = int(mesh.shape.get("member", 1))
+    return int(mesh.shape["data"]), member
 
 
 def index_pytree(tree: Any, i):
@@ -202,7 +239,13 @@ class GBMRegressor(_GBMParams):
             dummy = DummyRegressor(strategy="mean")
         return dummy.fit(X, y, sample_weight=w)
 
-    def fit(self, X, y, sample_weight=None, validation_indicator=None):
+    def fit(self, X, y, sample_weight=None, validation_indicator=None, mesh=None):
+        """Fit; with ``mesh`` (axes ("data",) or ("data", "member")) the whole
+        round step runs as ONE shard_map-ed SPMD program with rows sharded
+        over "data" — histograms/hessian-sums/line-search objectives reduce
+        via psum, the XLA replacement for the reference's executor-side
+        treeAggregate (`GBMRegressor.scala:373`, `GBMClassifier.scala:344-355`).
+        """
         X = as_f32(X)
         y = as_f32(y)
         w_all = resolve_weights(y, sample_weight)
@@ -224,7 +267,6 @@ class GBMRegressor(_GBMParams):
         bag_keys, masks = self._sampling_plan(n, d)
 
         init_model = self._fit_init(X, y, w)
-        pred = init_model.predict(X)
         huber = self.loss.lower() == "huber"
         # initial huber delta: alpha-quantile of the label over the full
         # input (reference `GBMRegressor.scala:305-308` uses `dataset`)
@@ -233,6 +275,27 @@ class GBMRegressor(_GBMParams):
             delta = weighted_quantile(full_y, self.alpha)
         else:
             delta = jnp.asarray(0.0, jnp.float32)
+
+        # ---- mesh setup: pad rows to the data-axis size, shard arrays ----
+        ax = None
+        n_pad = n
+        if mesh is not None:
+            data_size, _ = _mesh_sizes(mesh)
+            ax = "data"
+            n_pad = n + (-n) % data_size
+            ctx_specs = base.ctx_specs(ctx, "data")
+            ctx = _shard_put(_pad_ctx_rows(ctx, ctx_specs, n_pad), ctx_specs, mesh)
+            row = NamedSharding(mesh, P("data"))
+            row2 = NamedSharding(mesh, P("data", None))
+            X = jax.device_put(_pad_rows(X, n_pad), row2)
+            y = jax.device_put(_pad_rows(y, n_pad), row)
+            w = jax.device_put(_pad_rows(w, n_pad), row)
+            valid_w = jax.device_put(
+                _pad_rows(jnp.ones((n,), jnp.float32), n_pad), row
+            )
+        else:
+            valid_w = jnp.ones((n,), jnp.float32)
+        pred = init_model.predict(X)
 
         updates = self.updates.lower()
         optimized = bool(self.optimized_weights)
@@ -258,23 +321,23 @@ class GBMRegressor(_GBMParams):
         # all data flows through arguments so the jitted programs are
         # reusable across fits with the same config (no per-fit retrace)
         def build_round_step():
-            def round_step(ctx, X, bag_key, mask, pred, delta, y, w):
+            def round_core(ctx, X, bag_w, key, mask, pred, delta, y, w):
                 loss = make_loss(delta)
                 y_enc = loss.encode_label(y)
-                bag_w = bootstrap_weights(bag_key, y.shape[0], repl, sub_ratio)
                 labels, fit_w = _pseudo_residuals_and_weights(
-                    loss, updates, y_enc, pred[:, None], bag_w, w
+                    loss, updates, y_enc, pred[:, None], bag_w, w, axis_name=ax
                 )
                 params = base.fit_from_ctx(
-                    ctx, labels[:, 0], fit_w[:, 0], mask, bag_key
+                    ctx, labels[:, 0], fit_w[:, 0], mask, key, axis_name=ax
                 )
                 direction = base.predict_fn(params, X)
                 if optimized:
                     def phi(a):
                         # bag-multiplicity weighting only (`GBMLoss.scala:50-74`)
-                        return jnp.sum(
+                        v = jnp.sum(
                             bag_w * loss.loss(y_enc, (pred + a * direction)[:, None])
                         )
+                        return jax.lax.psum(v, ax) if ax is not None else v
                     alpha_opt = brent_minimize(
                         phi, 0.0, 100.0, tol=tol, max_iter=max_iter
                     )
@@ -284,7 +347,27 @@ class GBMRegressor(_GBMParams):
                 new_pred = pred + weight * direction
                 return params, weight, new_pred
 
-            return jax.jit(round_step)
+            if mesh is None:
+                return jax.jit(round_core)
+            return jax.jit(
+                shard_map(
+                    round_core,
+                    mesh=mesh,
+                    in_specs=(
+                        base.ctx_specs(ctx, "data"),
+                        P("data", None),  # X
+                        P("data"),  # bag_w
+                        P(),  # key
+                        P(),  # mask
+                        P("data"),  # pred
+                        P(),  # delta
+                        P("data"),  # y
+                        P("data"),  # w
+                    ),
+                    out_specs=(P(), P(), P("data")),
+                    check_vma=False,
+                )
+            )
 
         round_step = cached_program(
             (
@@ -299,16 +382,28 @@ class GBMRegressor(_GBMParams):
                 tol,
                 max_iter,
                 base_key,
+                mesh,
             ),
             build_round_step,
+        )
+
+        # per-round bag weights, drawn over the ORIGINAL n rows (bit-identical
+        # to the single-device draw) then zero-padded to the sharded length
+        bag_fn = cached_program(
+            ("gbm_bag", n, n_pad, repl, sub_ratio),
+            lambda: jax.jit(
+                lambda key: _pad_rows(
+                    bootstrap_weights(key, n, repl, sub_ratio), n_pad
+                )
+            ),
         )
 
         eval_loss = cached_program(
             ("gbm_reg_eval", loss_name, alpha_q),
             lambda: jax.jit(
                 lambda pred_v, delta, y_v: jnp.mean(
-                    self._make_loss(delta).loss(
-                        self._make_loss(delta).encode_label(y_v), pred_v[:, None]
+                    make_loss(delta).loss(
+                        make_loss(delta).encode_label(y_v), pred_v[:, None]
                     )
                 )
             ),
@@ -317,7 +412,9 @@ class GBMRegressor(_GBMParams):
         huber_delta = cached_program(
             ("gbm_reg_hdelta", alpha_q),
             lambda: jax.jit(
-                lambda pred, y: weighted_quantile(jnp.abs(y - pred), alpha_q)
+                lambda pred, y, vw: weighted_quantile(
+                    jnp.abs(y - pred), alpha_q, weights=vw
+                )
             ),
         )
 
@@ -344,15 +441,25 @@ class GBMRegressor(_GBMParams):
         ckpt = TrainingCheckpointer(
             self.checkpoint_dir,
             self.checkpoint_interval,
+            # n_pad is part of the identity: checkpointed `pred` is padded to
+            # the mesh's data-axis size, so a resume under a different mesh
+            # (different n_pad) must start fresh rather than load a
+            # wrong-length prediction state
             fingerprint=run_fingerprint(
-                type(self).__name__, self._resume_identity(), int(n), int(d)
+                type(self).__name__,
+                self._resume_identity(),
+                int(n),
+                int(d),
+                int(n_pad),
             ),
         )
         resumed = ckpt.load_latest()
         if resumed is not None:
             last_round, st = resumed
             i, v, best = last_round + 1, int(st["v"]), float(st["best"])
-            pred = st["pred"]
+            pred = jnp.asarray(st["pred"])
+            if mesh is not None:
+                pred = jax.device_put(pred, NamedSharding(mesh, P("data")))
             pred_val = st.get("pred_val")
             members = list(st["members"])
             weights = [jnp.asarray(x) for x in st["weights"]]
@@ -361,9 +468,9 @@ class GBMRegressor(_GBMParams):
 
         while i < self.num_base_learners and v < self.num_rounds:
             if huber:
-                delta = huber_delta(pred, y)
+                delta = huber_delta(pred, y, valid_w)
             params, weight, pred = round_step(
-                ctx, X, bag_keys[i], masks[i], pred, delta, y, w
+                ctx, X, bag_fn(bag_keys[i]), bag_keys[i], masks[i], pred, delta, y, w
             )
             members.append(params)
             weights.append(weight)
@@ -460,7 +567,13 @@ class GBMClassifier(_GBMParams):
     def _make_loss(self, num_classes):
         return losses_mod.get_classification_loss(self.loss.lower(), num_classes)
 
-    def fit(self, X, y, sample_weight=None, validation_indicator=None):
+    def fit(self, X, y, sample_weight=None, validation_indicator=None, mesh=None):
+        """Fit; with ``mesh`` the round runs as one shard_map-ed SPMD program:
+        rows sharded over "data" (psum histograms/hessians/objectives), class
+        dims block-sharded over "member" with an all_gather to rejoin
+        directions — the XLA replacement for the reference's executor
+        treeAggregate + per-class driver Futures
+        (`GBMClassifier.scala:344-355,377-411`)."""
         X = as_f32(X)
         y = as_f32(y)
         w_all = resolve_weights(y, sample_weight)
@@ -484,6 +597,19 @@ class GBMClassifier(_GBMParams):
         loss = self._make_loss(num_classes)
         dim = loss.dim
 
+        ax = None
+        member_size = 1
+        n_pad = n
+        if mesh is not None:
+            data_size, member_size = _mesh_sizes(mesh)
+            if dim % member_size != 0:
+                raise ValueError(
+                    f"class dim {dim} must be divisible by the 'member' mesh "
+                    f"axis size {member_size}"
+                )
+            ax = "data"
+            n_pad = n + (-n) % data_size
+
         # init raw scores (`GBMClassifier.scala:275-288`)
         init_model = DummyClassifier(strategy=self.init_strategy).fit(
             X, y, sample_weight=w
@@ -496,7 +622,6 @@ class GBMClassifier(_GBMParams):
             init_raw = jnp.zeros((1,), jnp.float32)
         else:
             init_raw = init_model.params["raw"]
-        pred = jnp.broadcast_to(init_raw[None, :], (n, dim)).astype(jnp.float32)
 
         updates = self.updates.lower()
         optimized = bool(self.optimized_weights)
@@ -510,17 +635,52 @@ class GBMClassifier(_GBMParams):
 
         y_enc = loss.encode_label(y)
 
+        # ---- mesh: pad rows, shard row-indexed arrays over "data" --------
+        if mesh is not None:
+            ctx_specs = base.ctx_specs(ctx, "data")
+            ctx = _shard_put(_pad_ctx_rows(ctx, ctx_specs, n_pad), ctx_specs, mesh)
+            row = NamedSharding(mesh, P("data"))
+            row2 = NamedSharding(mesh, P("data", None))
+            X = jax.device_put(_pad_rows(X, n_pad), row2)
+            y_enc = jax.device_put(_pad_rows(y_enc, n_pad), row2)
+            w = jax.device_put(_pad_rows(w, n_pad), row)
+        pred = jnp.broadcast_to(init_raw[None, :], (n_pad, dim)).astype(jnp.float32)
+        if mesh is not None:
+            pred = jax.device_put(pred, NamedSharding(mesh, P("data", None)))
+
         def build_round_step():
-            def round_step(ctx, X, y_enc, w, bag_key, mask, pred):
-                bag_w = bootstrap_weights(bag_key, y_enc.shape[0], repl, sub_ratio)
+            k_local = dim // member_size
+
+            def round_core(ctx, X, y_enc, w, bag_w, key, mask, pred):
                 labels, fit_w = _pseudo_residuals_and_weights(
-                    loss, updates, y_enc, pred, bag_w, w
+                    loss, updates, y_enc, pred, bag_w, w, axis_name=ax
                 )
+                if member_size > 1:
+                    # each member shard fits its block of class dims — the
+                    # SPMD replacement for the reference's per-dim Futures
+                    sl = jax.lax.axis_index("member") * k_local
+                    labels_blk = jax.lax.dynamic_slice_in_dim(
+                        labels, sl, k_local, axis=1
+                    )
+                    fitw_blk = jax.lax.dynamic_slice_in_dim(
+                        fit_w, sl, k_local, axis=1
+                    )
+                else:
+                    labels_blk, fitw_blk = labels, fit_w
                 # class-dim vmap replaces the reference's per-dim Futures
-                fit_j = lambda lab, fw: base.fit_from_ctx(ctx, lab, fw, mask, bag_key)
-                params = jax.vmap(fit_j, in_axes=(1, 1))(labels, fit_w)
+                fit_j = lambda lab, fw: base.fit_from_ctx(
+                    ctx, lab, fw, mask, key, axis_name=ax
+                )
+                params = jax.vmap(fit_j, in_axes=(1, 1))(labels_blk, fitw_blk)
                 directions = jax.vmap(lambda p: base.predict_fn(p, X))(params).T
+                if member_size > 1:
+                    directions = jax.lax.all_gather(
+                        directions, "member", axis=1, tiled=True
+                    )
                 if optimized:
+                    # SHARD-LOCAL objective; projected_newton_box psums
+                    # value/grad/hessian over `ax` itself (psum inside the
+                    # objective would break its autodiff — see linesearch.py)
                     def phi(a):
                         return jnp.sum(
                             bag_w * loss.loss(y_enc, pred + a[None, :] * directions)
@@ -530,6 +690,7 @@ class GBMClassifier(_GBMParams):
                         jnp.ones((dim,), jnp.float32),
                         max_iter=min(max_iter, 25),
                         tol=tol,
+                        axis_name=ax,
                     )
                 else:
                     alpha_opt = jnp.ones((dim,), jnp.float32)
@@ -537,7 +698,30 @@ class GBMClassifier(_GBMParams):
                 new_pred = pred + weight[None, :] * directions
                 return params, weight, new_pred
 
-            return jax.jit(round_step)
+            if mesh is None:
+                return jax.jit(round_core)
+            return jax.jit(
+                shard_map(
+                    round_core,
+                    mesh=mesh,
+                    in_specs=(
+                        base.ctx_specs(ctx, "data"),
+                        P("data", None),  # X
+                        P("data", None),  # y_enc
+                        P("data"),  # w
+                        P("data"),  # bag_w
+                        P(),  # key
+                        P(),  # mask
+                        P("data", None),  # pred
+                    ),
+                    out_specs=(
+                        P("member") if member_size > 1 else P(),
+                        P(),
+                        P("data", None),
+                    ),
+                    check_vma=False,
+                )
+            )
 
         round_key = (
             "gbm_cls_round",
@@ -551,8 +735,18 @@ class GBMClassifier(_GBMParams):
             tol,
             max_iter,
             base_key,
+            mesh,
         )
         round_step = cached_program(round_key, build_round_step)
+
+        bag_fn = cached_program(
+            ("gbm_bag", n, n_pad, repl, sub_ratio),
+            lambda: jax.jit(
+                lambda key: _pad_rows(
+                    bootstrap_weights(key, n, repl, sub_ratio), n_pad
+                )
+            ),
+        )
 
         eval_loss = cached_program(
             ("gbm_cls_eval", loss_name, num_classes),
@@ -587,19 +781,24 @@ class GBMClassifier(_GBMParams):
         ckpt = TrainingCheckpointer(
             self.checkpoint_dir,
             self.checkpoint_interval,
+            # n_pad in the identity: see GBMRegressor — padded `pred` must
+            # not be resumed under a mesh with a different data-axis size
             fingerprint=run_fingerprint(
                 type(self).__name__,
                 self._resume_identity(),
                 int(n),
                 int(d),
                 int(num_classes),
+                int(n_pad),
             ),
         )
         resumed = ckpt.load_latest()
         if resumed is not None:
             last_round, st = resumed
             i, v, best = last_round + 1, int(st["v"]), float(st["best"])
-            pred = st["pred"]
+            pred = jnp.asarray(st["pred"])
+            if mesh is not None:
+                pred = jax.device_put(pred, NamedSharding(mesh, P("data", None)))
             pred_val = st.get("pred_val")
             members = list(st["members"])
             weights = [jnp.asarray(x) for x in st["weights"]]
@@ -607,7 +806,7 @@ class GBMClassifier(_GBMParams):
 
         while i < self.num_base_learners and v < self.num_rounds:
             params, weight, pred = round_step(
-                ctx, X, y_enc, w, bag_keys[i], masks[i], pred
+                ctx, X, y_enc, w, bag_fn(bag_keys[i]), bag_keys[i], masks[i], pred
             )
             members.append(params)
             weights.append(weight)

@@ -43,12 +43,19 @@ def _apply_mask(X, feature_mask):
     return X * feature_mask.astype(X.dtype)[None, :]
 
 
-def _feature_stats(X, w):
+def _preduce(x, axis_name):
+    return jax.lax.psum(x, axis_name) if axis_name is not None else x
+
+
+def _feature_stats(X, w, axis_name=None):
     """Weighted per-feature mean and std (std floored; constant/masked
-    columns get sd=1 so they contribute nothing and stay solvable)."""
-    wsum = jnp.maximum(jnp.sum(w), 1e-30)
-    mu = jnp.sum(w[:, None] * X, axis=0) / wsum
-    var = jnp.sum(w[:, None] * (X - mu[None, :]) ** 2, axis=0) / wsum
+    columns get sd=1 so they contribute nothing and stay solvable).  With
+    ``axis_name`` the moments are psum-ed over the mesh data axis."""
+    wsum = jnp.maximum(_preduce(jnp.sum(w), axis_name), 1e-30)
+    mu = _preduce(jnp.sum(w[:, None] * X, axis=0), axis_name) / wsum
+    var = _preduce(
+        jnp.sum(w[:, None] * (X - mu[None, :]) ** 2, axis=0), axis_name
+    ) / wsum
     sd = jnp.sqrt(var)
     sd = jnp.where(sd > 1e-7 * (1.0 + jnp.abs(mu)), sd, 1.0)
     return mu, sd
@@ -60,19 +67,21 @@ class LinearRegression(BaseLearner):
 
     is_classifier = False
 
-    def fit_from_ctx(self, ctx, y, w, feature_mask, key):
+    def fit_from_ctx(self, ctx, y, w, feature_mask, key, axis_name=None):
         X = _apply_mask(ctx, feature_mask)
         n, d = X.shape
         # standardize features (Spark LinearRegression standardizes
         # internally too); essential for f32 normal equations on raw-scale
         # data like cpusmall (feature magnitudes up to ~1e6)
-        mu, sd = _feature_stats(X, w)
+        mu, sd = _feature_stats(X, w, axis_name)
         Xs = (X - mu[None, :]) / sd[None, :]
         if self.fit_intercept:
             Xs = jnp.concatenate([Xs, jnp.ones((n, 1), X.dtype)], axis=1)
         Xw = Xs * w[:, None]
-        A = Xs.T @ Xw + (self.reg_param + 1e-6) * jnp.eye(Xs.shape[1], dtype=X.dtype)
-        b = Xw.T @ y
+        A = _preduce(Xs.T @ Xw, axis_name) + (self.reg_param + 1e-6) * jnp.eye(
+            Xs.shape[1], dtype=X.dtype
+        )
+        b = _preduce(Xw.T @ y, axis_name)
         beta = jax.scipy.linalg.solve(A, b, assume_a="pos")
         coef_s = beta[:d] if self.fit_intercept else beta
         icpt_s = beta[d] if self.fit_intercept else jnp.asarray(0.0, X.dtype)
@@ -136,20 +145,20 @@ class LogisticRegression(BaseLearner):
     def make_fit_ctx(self, X, num_classes=None):
         return {"X": as_f32(X), "num_classes": Static(num_classes)}
 
-    def fit_from_ctx(self, ctx, y, w, feature_mask, key):
+    def fit_from_ctx(self, ctx, y, w, feature_mask, key, axis_name=None):
         X = _apply_mask(ctx["X"], feature_mask)
         k = static_value(ctx["num_classes"])
         n, d = X.shape
-        mu, sd = _feature_stats(X, w)
+        mu, sd = _feature_stats(X, w, axis_name)
         Xs = (X - mu[None, :]) / sd[None, :]
         onehot = jax.nn.one_hot(y.astype(jnp.int32), k)
-        w_norm = w / jnp.maximum(jnp.sum(w), 1e-30)
+        w_norm = w / jnp.maximum(_preduce(jnp.sum(w), axis_name), 1e-30)
 
         def objective(theta):
             logits = Xs @ theta["coef"] + theta["intercept"][None, :]
             ce = -jnp.sum(onehot * jax.nn.log_softmax(logits, axis=-1), axis=-1)
             reg = 0.5 * self.reg_param * jnp.sum(theta["coef"] ** 2)
-            return jnp.sum(w_norm * ce) + reg
+            return _preduce(jnp.sum(w_norm * ce), axis_name) + reg
 
         init = {
             "coef": jnp.zeros((d, k), jnp.float32),

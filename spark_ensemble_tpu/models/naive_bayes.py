@@ -30,18 +30,25 @@ class GaussianNaiveBayes(BaseLearner):
     def make_fit_ctx(self, X, num_classes=None):
         return {"X": as_f32(X), "num_classes": Static(num_classes)}
 
-    def fit_from_ctx(self, ctx, y, w, feature_mask, key):
+    def fit_from_ctx(self, ctx, y, w, feature_mask, key, axis_name=None):
+        def preduce(v):
+            return jax.lax.psum(v, axis_name) if axis_name is not None else v
+
         X = ctx["X"]
         k = static_value(ctx["num_classes"])
         d = X.shape[1]
         onehot = jax.nn.one_hot(y.astype(jnp.int32), k)  # [n, k]
         wc = onehot * w[:, None]  # [n, k]
-        class_w = jnp.sum(wc, axis=0)  # [k]
-        mean = (wc.T @ X) / jnp.maximum(class_w[:, None], 1e-30)  # [k, d]
-        sq = wc.T @ (X * X)
+        class_w = preduce(jnp.sum(wc, axis=0))  # [k]
+        mean = preduce(wc.T @ X) / jnp.maximum(class_w[:, None], 1e-30)  # [k, d]
+        sq = preduce(wc.T @ (X * X))
         var = sq / jnp.maximum(class_w[:, None], 1e-30) - mean * mean
+        # global unweighted feature variance for the smoothing floor
+        n_glob = preduce(jnp.asarray(X.shape[0], jnp.float32))
+        x_mu = preduce(jnp.sum(X, axis=0)) / n_glob
+        x_var = preduce(jnp.sum((X - x_mu[None, :]) ** 2, axis=0)) / n_glob
         var = jnp.maximum(var, 0.0) + self.var_smoothing * jnp.maximum(
-            jnp.var(X, axis=0), 1e-12
+            x_var, 1e-12
         )
         prior = class_w / jnp.maximum(jnp.sum(class_w), 1e-30)
         mask = (

@@ -49,7 +49,7 @@ class _TreeLearner(BaseLearner):
     def _targets(self, ctx, y) -> jax.Array:
         raise NotImplementedError
 
-    def fit_from_ctx(self, ctx, y, w, feature_mask, key):
+    def fit_from_ctx(self, ctx, y, w, feature_mask, key, axis_name=None):
         return fit_tree(
             ctx["Xb"],
             self._targets(ctx, y),
@@ -59,7 +59,17 @@ class _TreeLearner(BaseLearner):
             max_depth=self.max_depth,
             max_bins=self.max_bins,
             min_info_gain=self.min_info_gain,
+            axis_name=axis_name,
         )
+
+    def ctx_specs(self, ctx, data_axis):
+        from jax.sharding import PartitionSpec as P
+
+        return {
+            "Xb": P(data_axis, None),
+            "thresholds": P(),
+            "num_classes": ctx["num_classes"],
+        }
 
 
 class DecisionTreeRegressor(_TreeLearner):

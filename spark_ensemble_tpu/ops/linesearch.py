@@ -115,6 +115,7 @@ def projected_newton_box(
     max_iter: int = 20,
     tol: float = 1e-6,
     num_backtracks: int = 15,
+    axis_name=None,
 ) -> jax.Array:
     """Minimize ``f`` over the box ``x >= lower`` by projected Newton.
 
@@ -122,10 +123,25 @@ def projected_newton_box(
     gradient; the Newton system is solved on the free set via masked
     Cholesky-backed solve with a small ridge; steps are Armijo-backtracked
     (candidate step sizes evaluated in one vmapped sweep).
+
+    Inside ``shard_map`` with data-sharded rows, pass the SHARD-LOCAL
+    objective plus ``axis_name``: the value, gradient, and Hessian are each
+    psum-ed over the mesh axis here, so every shard runs the identical
+    Newton iteration on the global objective.  (Passing an objective that
+    already psums internally would silently produce *local* gradients —
+    the transpose of ``psum`` does not re-reduce cotangents across shards —
+    which is the distributed-line-search bug this parameter exists to
+    prevent.  The reference's analogue is each breeze LBFGS-B evaluation
+    being a full treeAggregate pass, `GBMClassifier.scala:413-431`.)
     """
     k = x0.shape[0]
-    grad_f = jax.grad(f)
-    hess_f = jax.hessian(f)
+
+    def red(v):
+        return jax.lax.psum(v, axis_name) if axis_name is not None else v
+
+    fval = lambda x: red(f(x))
+    grad_f = lambda x: red(jax.grad(f)(x))
+    hess_f = lambda x: red(jax.hessian(f)(x))
     ts = 0.5 ** jnp.arange(num_backtracks, dtype=jnp.float32)
 
     def proj(x):
@@ -144,7 +160,7 @@ def projected_newton_box(
         step = -jax.scipy.linalg.solve(Hm, g * fm, assume_a="pos") * fm
 
         cand = jax.vmap(lambda t: proj(x + t * step))(ts)
-        fc = jax.vmap(f)(cand)
+        fc = jax.vmap(fval)(cand)
         ok = fc < fx  # sufficient decrease
         idx = jnp.argmax(ok)
         any_ok = jnp.any(ok)
@@ -152,5 +168,7 @@ def projected_newton_box(
         f_new = jnp.where(any_ok, fc[idx], fx)
         return (x_new, f_new), None
 
-    (x, _), _ = jax.lax.scan(body, (proj(x0), f(proj(x0))), None, length=max_iter)
+    (x, _), _ = jax.lax.scan(
+        body, (proj(x0), fval(proj(x0))), None, length=max_iter
+    )
     return x

@@ -97,17 +97,21 @@ def make_sharded_gbm_round(
             dir_blk, "member", axis=1, tiled=True
         )  # [n_loc, K]
 
-        # ---- K-dim line search with psum objective ------------------------
+        # ---- K-dim line search, value/grad/hess psum-ed over "data" -------
         if optimized_weights:
 
             def phi(a):
-                return jax.lax.psum(
-                    jnp.sum(bag_w * loss.loss(y_enc, pred + a[None, :] * directions)),
-                    "data",
+                # shard-local; projected_newton_box psums over "data" itself
+                # (a psum inside the objective would yield local gradients)
+                return jnp.sum(
+                    bag_w * loss.loss(y_enc, pred + a[None, :] * directions)
                 )
 
             alpha = projected_newton_box(
-                phi, jnp.ones((dim,), jnp.float32), max_iter=line_search_iters
+                phi,
+                jnp.ones((dim,), jnp.float32),
+                max_iter=line_search_iters,
+                axis_name="data",
             )
         else:
             alpha = jnp.ones((dim,), jnp.float32)
