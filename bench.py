@@ -31,7 +31,9 @@ _METRIC = "GBM boosting-iters/sec/chip (letter)"
 # vs_baseline for later rounds = measured / baseline on the same platform.
 _BASELINES = {
     "cpu": None,  # filled from the first captured CPU number
-    "tpu": None,  # filled from the first captured TPU number
+    # round 2, TPU v5 lite, letter 100 rounds, newton+line-search
+    # (BASELINE.md "Measured" table)
+    "tpu": 6.991,
 }
 
 

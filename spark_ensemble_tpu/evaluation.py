@@ -54,8 +54,9 @@ def _regression_metrics(pred, y, w):
     y_mean = jnp.sum(w * y) / sw
     ss_tot = jnp.sum(w * (y - y_mean) ** 2) / sw
     r2 = 1.0 - mse / jnp.maximum(ss_tot, 1e-30)
-    e_mean = jnp.sum(w * err) / sw
-    var = jnp.sum(w * (err - e_mean) ** 2) / sw
+    # Spark's 'var' is EXPLAINED variance (SSreg / weightSum), larger-better
+    # (SPARK RegressionMetrics.explainedVariance), not residual variance
+    var = jnp.sum(w * (pred - y_mean) ** 2) / sw
     return {"mse": mse, "rmse": jnp.sqrt(mse), "mae": mae, "r2": r2, "var": var}
 
 
@@ -66,7 +67,7 @@ class RegressionEvaluator(Evaluator):
 
     @property
     def is_larger_better(self):
-        return self.metric.lower() == "r2"
+        return self.metric.lower() in ("r2", "var")
 
     def evaluate(self, model, X, y, sample_weight=None) -> float:
         y = jnp.asarray(y, jnp.float32)
@@ -214,9 +215,11 @@ class BinaryClassificationEvaluator(Evaluator):
         score = proba[:, 1]
         tpr, fpr, precision = _binary_curves(score, y, w)
         if self.metric.lower() == "areaunderpr":
-            # anchor at (recall=0, precision=1) like Spark
+            # anchor at (recall=0, firstPrecision) like Spark (SPARK-21806):
+            # the (0, 1) anchor inflates AUPR when thresholds are few — a
+            # constant scorer would score (1 + baseRate)/2 instead of baseRate
             recall = jnp.concatenate([jnp.zeros((1,)), tpr])
-            prec = jnp.concatenate([jnp.ones((1,)), precision])
+            prec = jnp.concatenate([precision[:1], precision])
             return float(jnp.trapezoid(prec, recall))
         tpr = jnp.concatenate([jnp.zeros((1,)), tpr])
         fpr = jnp.concatenate([jnp.zeros((1,)), fpr])

@@ -40,6 +40,7 @@ from spark_ensemble_tpu.models.tree import (
     DecisionTreeRegressor,
 )
 from spark_ensemble_tpu.params import Param, gt_eq, in_array, in_range
+from spark_ensemble_tpu.utils.instrumentation import instrumented_fit
 from spark_ensemble_tpu.utils.random import bootstrap_weights, subspace_mask
 
 
@@ -109,6 +110,7 @@ class BaggingRegressor(_BaggingParams):
     def _base(self) -> BaseLearner:
         return self.base_learner or DecisionTreeRegressor()
 
+    @instrumented_fit
     def fit(self, X, y, sample_weight=None, mesh=None) -> "BaggingRegressionModel":
         X, y = as_f32(X), as_f32(y)
         w = resolve_weights(y, sample_weight)
@@ -163,10 +165,13 @@ class BaggingClassifier(_BaggingParams):
     def _base(self) -> BaseLearner:
         return self.base_learner or DecisionTreeClassifier()
 
-    def fit(self, X, y, sample_weight=None, mesh=None) -> "BaggingClassificationModel":
+    @instrumented_fit
+    def fit(
+        self, X, y, sample_weight=None, mesh=None, num_classes=None
+    ) -> "BaggingClassificationModel":
         X, y = as_f32(X), as_f32(y)
         w = resolve_weights(y, sample_weight)
-        num_classes = infer_num_classes(y)
+        num_classes = infer_num_classes(y, num_classes)
         n, d = X.shape
         # snapshot the base learner: cached round-step closures must not
         # observe later set_params mutations of the caller's instance
@@ -199,6 +204,17 @@ class BaggingClassifier(_BaggingParams):
 
 
 class BaggingClassificationModel(ClassificationModel, BaggingClassifier):
+    def member_class_predictions(self, X):
+        """Per-member class predictions ``f32[m, n]`` (the reference tests'
+        member-agreement/diversity assertions use these,
+        `BaggingClassifierSuite.scala:80-155`)."""
+        base = self._base()
+        fn = self._cached_jit(
+            "member_preds",
+            lambda members, Xq: jax.vmap(lambda p: base.predict_fn(p, Xq))(members),
+        )
+        return fn(self.params["members"], as_f32(X))
+
     def predict_raw(self, X):
         base = self._base()
         if self.voting_strategy.lower() == "soft":

@@ -10,11 +10,13 @@ learner exposes a *pure functional* triple:
   - ``make_fit_ctx(X, num_classes)``: shared preprocessing computed once per
     ensemble fit (e.g. quantile binning for trees) — hoisted out of the
     member loop so members share it;
-  - ``fit_from_ctx(ctx, y, w, feature_mask, key) -> params``: a pure,
-    jit-compiled, **vmappable** fit over fixed-shape arrays.  Row sampling
-    arrives via ``w`` (Poisson/Bernoulli weights) and feature subspaces via
-    ``feature_mask`` — the static-shape encoding of the reference's
-    ``RDD.sample`` + ``slice`` (`HasSubBag.scala:73-84`);
+  - ``fit_from_ctx(ctx, y, w, feature_mask, key, axis_name=None) ->
+    params``: a pure, jit-compiled, **vmappable** fit over fixed-shape
+    arrays.  Row sampling arrives via ``w`` (Poisson/Bernoulli weights) and
+    feature subspaces via ``feature_mask`` — the static-shape encoding of
+    the reference's ``RDD.sample`` + ``slice`` (`HasSubBag.scala:73-84`);
+    under ``shard_map`` row sharding the learner psums its sufficient
+    statistics over ``axis_name`` (see ``ops.collective.preduce``);
   - ``predict_fn(params, X)`` (+ ``predict_raw_fn``/``predict_proba_fn`` for
     classifiers): pure predict, vmappable over a stacked member axis.
 
@@ -37,7 +39,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_ensemble_tpu.params import Params
+from spark_ensemble_tpu.params import Param, Params
+from spark_ensemble_tpu.utils.instrumentation import instrumented_fit
 
 
 def as_f32(x) -> jax.Array:
@@ -103,8 +106,34 @@ def resolve_weights(y: jax.Array, sample_weight) -> jax.Array:
     return as_f32(sample_weight)
 
 
-def infer_num_classes(y) -> int:
-    return int(np.asarray(y).max()) + 1
+def infer_num_classes(y, num_classes: Optional[int] = None) -> int:
+    """Class count from labels, with the reference's label validation
+    (`BoostingClassifier.scala:152-161` via ``extractInstances``): labels
+    must be finite non-negative integers.  An explicit ``num_classes``
+    overrides inference — required when a split (e.g. a validation fold)
+    may not contain the top class — and labels must lie in [0, K)."""
+    ya = np.asarray(y)
+    if ya.size == 0:
+        raise ValueError("cannot infer num_classes from empty labels")
+    if not np.all(np.isfinite(ya)):
+        raise ValueError("classification labels must be finite")
+    if np.any(ya != np.round(ya)) or np.any(ya < 0):
+        bad = ya[(ya != np.round(ya)) | (ya < 0)][0]
+        raise ValueError(
+            f"classification labels must be non-negative integers; got {bad!r}"
+        )
+    k = int(ya.max()) + 1
+    if num_classes is not None:
+        num_classes = int(num_classes)
+        if num_classes < 2:
+            raise ValueError(f"num_classes must be >= 2; got {num_classes}")
+        if k > num_classes:
+            raise ValueError(
+                f"labels contain class {k - 1} but num_classes={num_classes}; "
+                f"labels must lie in [0, num_classes)"
+            )
+        return num_classes
+    return max(k, 2)
 
 
 class Model(Params):
@@ -117,6 +146,25 @@ class Model(Params):
 
     def predict(self, X) -> jax.Array:
         raise NotImplementedError
+
+    @property
+    def feature_metadata(self):
+        """Feature names for this model's input columns
+        (`Utils.getFeaturesMetadata`, `Utils.scala:42-61`); anonymous
+        ``f{i}`` names when the ``feature_names`` param was not set."""
+        from spark_ensemble_tpu.utils.features import FeatureMetadata
+
+        return FeatureMetadata.resolve(self.feature_names, self.num_features)
+
+    def member_feature_names(self, i: int):
+        """Feature names of member ``i``'s subspace — the reference
+        re-indexes column metadata after ``slice()`` the same way."""
+        masks = self.params.get("masks") if isinstance(self.params, dict) else None
+        if masks is None:
+            raise AttributeError(
+                f"{type(self).__name__} has no per-member feature subspaces"
+            )
+        return self.feature_metadata.select(np.asarray(masks[i])).names
 
     def _cached_jit(self, name: str, builder):
         """Per-instance jit cache: model predict paths are built once and
@@ -161,11 +209,63 @@ class ClassificationModel(Model):
         return jnp.argmax(self.predict_proba(X), axis=-1).astype(jnp.float32)
 
 
+class CheckpointableParams(Params):
+    """Shared checkpoint/resume plumbing for the iterative estimators
+    (GBM, Boosting) — one copy of the resume-identity exclusion list so a
+    new observability param cannot silently invalidate checkpoints in one
+    family but not another."""
+
+    # params that do NOT affect training math: excluded from the resume
+    # fingerprint so budget/cadence/observability changes keep checkpoints
+    # resumable
+    _RESUME_EXCLUDED = (
+        "num_base_learners",
+        "checkpoint_interval",
+        "checkpoint_dir",
+        "profile_dir",
+        "feature_names",
+    )
+
+    def _resume_identity(self):
+        p = self.params_to_json_dict()
+        for k in self._RESUME_EXCLUDED:
+            p.pop(k, None)
+        return p
+
+    def _checkpointer(self, *shape_parts):
+        from spark_ensemble_tpu.utils.checkpoint import (
+            TrainingCheckpointer,
+            run_fingerprint,
+        )
+
+        return TrainingCheckpointer(
+            self.checkpoint_dir,
+            self.checkpoint_interval,
+            fingerprint=run_fingerprint(
+                type(self).__name__,
+                self._resume_identity(),
+                *[int(s) for s in shape_parts],
+            ),
+        )
+
+
 class Estimator(Params):
     """Base estimator: ``fit(X, y, sample_weight) -> Model``."""
 
     is_classifier = False
     supports_weight = True
+
+    profile_dir = Param(
+        None,
+        doc="when set, every fit() captures a jax.profiler trace "
+        "(TensorBoard-viewable) into this directory — the TPU analogue of "
+        "the reference tests' spark.time wall-clock prints (SURVEY.md §5)",
+    )
+    feature_names = Param(
+        None,
+        doc="optional column names for X; carried onto fitted models and "
+        "re-indexed through feature subspaces (`Utils.scala:42-61`)",
+    )
 
     def fit(self, X, y, sample_weight=None) -> Model:
         raise NotImplementedError
@@ -226,11 +326,14 @@ class BaseLearner(Estimator):
     # ------------------------------------------------------------------
     # standalone sklearn-style fit built on the functional protocol
     # ------------------------------------------------------------------
-    def fit(self, X, y, sample_weight=None) -> Model:
+    @instrumented_fit
+    def fit(self, X, y, sample_weight=None, num_classes=None) -> Model:
         X = as_f32(X)
         y = as_f32(y)
         w = resolve_weights(y, sample_weight)
-        num_classes = infer_num_classes(y) if self.is_classifier else None
+        num_classes = (
+            infer_num_classes(y, num_classes) if self.is_classifier else None
+        )
         ctx = self.make_fit_ctx(X, num_classes)
         key = jax.random.PRNGKey(getattr(self, "seed", 0) or 0)
         params = self.fit_from_ctx(ctx, y, w, None, key)

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 
 from spark_ensemble_tpu.models.base import (
     BaseLearner,
+    CheckpointableParams,
     ClassificationModel,
     Estimator,
     RegressionModel,
@@ -57,7 +58,10 @@ from spark_ensemble_tpu.models.tree import (
     DecisionTreeRegressor,
 )
 from spark_ensemble_tpu.params import Param, gt_eq, in_array
-from spark_ensemble_tpu.utils.instrumentation import Instrumentation
+from spark_ensemble_tpu.utils.instrumentation import (
+    Instrumentation,
+    instrumented_fit,
+)
 from spark_ensemble_tpu.utils.quantile import weighted_median
 
 logger = logging.getLogger(__name__)
@@ -65,12 +69,20 @@ logger = logging.getLogger(__name__)
 EPSILON = 2.220446049250313e-16  # Spark MLUtils.EPSILON (double ulp of 1.0)
 
 
-class _BoostingParams(Estimator):
+class _BoostingParams(CheckpointableParams, Estimator):
     """Reference `BoostingParams.scala:26-37`."""
 
     base_learner = Param(None, is_estimator=True)
     num_base_learners = Param(10, gt_eq(1))
-    checkpoint_interval = Param(10, doc="API parity; no RDD lineage to truncate")
+    checkpoint_interval = Param(10, gt_eq(1))
+    checkpoint_dir = Param(
+        None,
+        doc="when set, training state (round, members, boosting weights) is "
+        "checkpointed every checkpoint_interval rounds and fit() resumes "
+        "from the latest checkpoint — the TPU upgrade of the reference's "
+        "lineage-only PeriodicRDDCheckpointer (`BoostingRegressor.scala:"
+        "202-206`, SURVEY.md §5)",
+    )
     aggregation_depth = Param(2, gt_eq(1), doc="API parity; reductions are psum")
     seed = Param(0)
 
@@ -83,10 +95,13 @@ class BoostingClassifier(_BoostingParams):
     def _base(self) -> BaseLearner:
         return self.base_learner or DecisionTreeClassifier()
 
-    def fit(self, X, y, sample_weight=None) -> "BoostingClassificationModel":
+    @instrumented_fit
+    def fit(
+        self, X, y, sample_weight=None, num_classes=None
+    ) -> "BoostingClassificationModel":
         X, y = as_f32(X), as_f32(y)
         w = resolve_weights(y, sample_weight)
-        num_classes = infer_num_classes(y)
+        num_classes = infer_num_classes(y, num_classes)
         n, d = X.shape
         instr = Instrumentation("BoostingClassifier.fit")
         instr.log_params(self.get_params())
@@ -137,6 +152,15 @@ class BoostingClassifier(_BoostingParams):
         members: List[Any] = []
         est_weights: List[float] = []
         i = 0
+        ckpt = self._checkpointer(n, d, num_classes)
+        resumed = ckpt.load_latest()
+        if resumed is not None:
+            last_round, st = resumed
+            i = last_round + 1
+            bw = jnp.asarray(st["bw"])
+            members = list(st["members"])
+            est_weights = [float(x) for x in st["est_weights"]]
+            logger.info("BoostingClassifier resuming from round %d", i)
         while i < self.num_base_learners and float(jnp.sum(bw)) > 0:
             params, err, est_weight, new_bw = step(
                 ctx, X, y, bw, jax.random.fold_in(root, i)
@@ -152,7 +176,11 @@ class BoostingClassifier(_BoostingParams):
             logger.info("BoostingClassifier round %d: err=%.4f", i, err)
             if err <= 0:
                 break
+            ckpt.maybe_save(
+                i, {"bw": bw, "members": members, "est_weights": list(est_weights)}
+            )
             i += 1
+        ckpt.delete()
         instr.log_outcome(members=len(members))
         return BoostingClassificationModel(
             params={
@@ -226,14 +254,7 @@ class BoostingRegressor(_BoostingParams):
     def _base(self) -> BaseLearner:
         return self.base_learner or DecisionTreeRegressor()
 
-    def _shape_loss(self, e):
-        name = self.loss.lower()
-        if name == "exponential":
-            return 1.0 - jnp.exp(-e)
-        if name == "squared":
-            return e * e
-        return e
-
+    @instrumented_fit
     def fit(self, X, y, sample_weight=None) -> "BoostingRegressionModel":
         X, y = as_f32(X), as_f32(y)
         w = resolve_weights(y, sample_weight)
@@ -246,8 +267,19 @@ class BoostingRegressor(_BoostingParams):
         base = self._base().copy()
         ctx = base.make_fit_ctx(X)
         root = jax.random.PRNGKey(self.seed)
+        # snapshot the loss name: the cached closure must not read `self.loss`
+        # at (re)trace time — set_params(loss=...) after fit would otherwise
+        # run the wrong shaping under the original cache key
+        loss_name = self.loss.lower()
 
         def build_step():
+            def shape_loss(e):
+                if loss_name == "exponential":
+                    return 1.0 - jnp.exp(-e)
+                if loss_name == "squared":
+                    return e * e
+                return e
+
             def step(ctx, X, y, bw, key):
                 w_norm = bw / jnp.maximum(jnp.sum(bw), 1e-30)
                 params = base.fit_from_ctx(ctx, y, w_norm, None, key)
@@ -256,7 +288,7 @@ class BoostingRegressor(_BoostingParams):
                 rel = jnp.where(
                     max_error > 0, errors / jnp.maximum(max_error, 1e-30), errors
                 )
-                losses = self._shape_loss(rel)
+                losses = shape_loss(rel)
                 est_err = jnp.sum(w_norm * losses)
                 beta = est_err / jnp.maximum(1.0 - est_err, 1e-30)
                 est_weight = jnp.where(
@@ -269,13 +301,22 @@ class BoostingRegressor(_BoostingParams):
             return jax.jit(step)
 
         step = cached_program(
-            ("boosting_reg_round", self.loss.lower(), base.config_key()), build_step
+            ("boosting_reg_round", loss_name, base.config_key()), build_step
         )
 
         bw = w
         members: List[Any] = []
         est_weights: List[float] = []
         i = 0
+        ckpt = self._checkpointer(n, d)
+        resumed = ckpt.load_latest()
+        if resumed is not None:
+            last_round, st = resumed
+            i = last_round + 1
+            bw = jnp.asarray(st["bw"])
+            members = list(st["members"])
+            est_weights = [float(x) for x in st["est_weights"]]
+            logger.info("BoostingRegressor resuming from round %d", i)
         while i < self.num_base_learners and float(jnp.sum(bw)) > 0:
             params, max_error, est_err, est_weight, new_bw = step(
                 ctx, X, y, bw, jax.random.fold_in(root, i)
@@ -298,7 +339,11 @@ class BoostingRegressor(_BoostingParams):
             est_weights.append(float(est_weight))
             bw = new_bw
             logger.info("BoostingRegressor round %d: est_err=%.4f", i, est_err)
+            ckpt.maybe_save(
+                i, {"bw": bw, "members": members, "est_weights": list(est_weights)}
+            )
             i += 1
+        ckpt.delete()
         instr.log_outcome(members=len(members))
         return BoostingRegressionModel(
             params={

@@ -17,6 +17,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
+from spark_ensemble_tpu.ops.collective import preduce
 from spark_ensemble_tpu.models.base import (
     Static,
     static_value,
@@ -40,14 +41,16 @@ class DummyRegressor(BaseLearner):
     def make_fit_ctx(self, X, num_classes=None):
         return None
 
-    def fit_from_ctx(self, ctx, y, w, feature_mask, key):
+    def fit_from_ctx(self, ctx, y, w, feature_mask, key, axis_name=None):
         strategy = self.strategy.lower()
         if strategy == "mean":
-            value = jnp.sum(w * y) / jnp.maximum(jnp.sum(w), 1e-30)
+            sw_y = preduce(jnp.sum(w * y), axis_name)
+            sw = preduce(jnp.sum(w), axis_name)
+            value = sw_y / jnp.maximum(sw, 1e-30)
         elif strategy == "median":
-            value = weighted_median(y, w)
+            value = weighted_median(y, w, axis_name=axis_name)
         elif strategy == "quantile":
-            value = weighted_quantile(y, self.quantile, w)
+            value = weighted_quantile(y, self.quantile, w, axis_name=axis_name)
         else:
             value = jnp.asarray(self.constant, jnp.float32)
         return {"value": as_f32(value)}
@@ -75,14 +78,14 @@ class DummyClassifier(BaseLearner):
     def make_fit_ctx(self, X, num_classes=None):
         return {"num_classes": Static(num_classes)}
 
-    def fit_from_ctx(self, ctx, y, w, feature_mask, key):
+    def fit_from_ctx(self, ctx, y, w, feature_mask, key, axis_name=None):
         k = static_value(ctx["num_classes"])
         strategy = self.strategy.lower()
         if strategy == "uniform":
             proba = jnp.full((k,), 1.0 / k, jnp.float32)
         elif strategy == "prior":
             onehot = jax.nn.one_hot(y.astype(jnp.int32), k)
-            counts = jnp.sum(w[:, None] * onehot, axis=0)
+            counts = preduce(jnp.sum(w[:, None] * onehot, axis=0), axis_name)
             proba = counts / jnp.maximum(jnp.sum(counts), 1e-30)
         else:
             proba = jax.nn.one_hot(jnp.asarray(self.constant, jnp.int32), k)

@@ -32,6 +32,20 @@ Re-designs the reference's GBM loop (`GBMRegressor.scala:237-476`,
 The round loop itself stays on the host (data-dependent stopping), carrying
 predictions as device arrays — the analogue of the reference's RDD lineage,
 minus the need for ``PeriodicRDDCheckpointer``.
+
+Distributed mapping (``fit(..., mesh=...)`` — the SPMD replacement for the
+reference's entire distribution story, `GBMClassifier.scala:325-483`):
+
+| reference (Spark)                        | here (XLA)                        |
+|------------------------------------------|-----------------------------------|
+| RDD rows on executors                    | rows sharded over mesh "data"     |
+| treeReduce/treeAggregate (hessian sums,  | lax.psum over "data"              |
+|   split histograms via base-learner jobs)|                                   |
+| driver Futures over K class dims         | class-dim block sharded over      |
+|                                          |   "member", all_gather to rejoin  |
+| Broadcast(line-search coefficients)      | replicated operands (SPMD)        |
+| breeze LBFGS-B on the driver, each       | projected Newton inside the       |
+|   evaluation a distributed pass          |   shard_map; psum per evaluation  |
 """
 
 from __future__ import annotations
@@ -47,6 +61,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_ensemble_tpu.models.base import (
     BaseLearner,
+    CheckpointableParams,
     ClassificationModel,
     Estimator,
     RegressionModel,
@@ -60,7 +75,10 @@ from spark_ensemble_tpu.models.tree import DecisionTreeRegressor
 from spark_ensemble_tpu.ops import losses as losses_mod
 from spark_ensemble_tpu.ops.linesearch import brent_minimize, projected_newton_box
 from spark_ensemble_tpu.params import Param, gt, gt_eq, in_array, in_range
-from spark_ensemble_tpu.utils.instrumentation import Instrumentation
+from spark_ensemble_tpu.utils.instrumentation import (
+    Instrumentation,
+    instrumented_fit,
+)
 from spark_ensemble_tpu.utils.quantile import weighted_quantile
 from spark_ensemble_tpu.utils.random import (
     bootstrap_weights,
@@ -117,7 +135,7 @@ def slice_pytree(tree: Any, n: int):
     return jax.tree_util.tree_map(lambda x: x[:n], tree)
 
 
-class _GBMParams(Estimator):
+class _GBMParams(CheckpointableParams, Estimator):
     """Shared GBM params (reference `GBMParams.scala:29-137` defaults)."""
 
     base_learner = Param(None, is_estimator=True)
@@ -158,21 +176,38 @@ class _GBMParams(Estimator):
             bag_keys.append(jax.random.fold_in(k, 2))
         return jnp.stack(bag_keys), jnp.stack(masks)
 
-    def _resume_identity(self):
-        """Params that must match for a checkpoint to be resumable; budget
-        and checkpointing knobs are excluded so a run can be resumed with a
-        larger member budget or different checkpoint cadence."""
-        p = self.params_to_json_dict()
-        for k in ("num_base_learners", "checkpoint_interval", "checkpoint_dir"):
-            p.pop(k, None)
-        return p
-
     @staticmethod
     def _patience_step(best: float, err: float, v: int, validation_tol: float):
         """Reference early-stop bookkeeping (`GBMRegressor.scala:457-465`)."""
         if best - err < validation_tol * max(err, 0.01):
             return best, v + 1
         return err, 0
+
+    def _make_bag_fn(self, n: int, n_pad: int):
+        """Per-round bag weights, drawn over the ORIGINAL n rows
+        (bit-identical to the single-device draw) then zero-padded to the
+        sharded length.  One copy shared by both GBM flavors so their
+        bagging draws can never silently diverge."""
+        repl, sub_ratio = bool(self.replacement), float(self.subsample_ratio)
+        return cached_program(
+            ("gbm_bag", n, n_pad, repl, sub_ratio),
+            lambda: jax.jit(
+                lambda key: _pad_rows(
+                    bootstrap_weights(key, n, repl, sub_ratio), n_pad
+                )
+            ),
+        )
+
+    @staticmethod
+    def _shard_fit_rows(mesh: Mesh, base: BaseLearner, ctx, X, n_pad: int):
+        """Pad the fit ctx and feature matrix to the data-axis size and
+        device_put them row-sharded over "data"."""
+        ctx_specs = base.ctx_specs(ctx, "data")
+        ctx = _shard_put(_pad_ctx_rows(ctx, ctx_specs, n_pad), ctx_specs, mesh)
+        X = jax.device_put(
+            _pad_rows(X, n_pad), NamedSharding(mesh, P("data", None))
+        )
+        return ctx, X
 
 
 def _pseudo_residuals_and_weights(
@@ -239,6 +274,7 @@ class GBMRegressor(_GBMParams):
             dummy = DummyRegressor(strategy="mean")
         return dummy.fit(X, y, sample_weight=w)
 
+    @instrumented_fit
     def fit(self, X, y, sample_weight=None, validation_indicator=None, mesh=None):
         """Fit; with ``mesh`` (axes ("data",) or ("data", "member")) the whole
         round step runs as ONE shard_map-ed SPMD program with rows sharded
@@ -283,11 +319,8 @@ class GBMRegressor(_GBMParams):
             data_size, _ = _mesh_sizes(mesh)
             ax = "data"
             n_pad = n + (-n) % data_size
-            ctx_specs = base.ctx_specs(ctx, "data")
-            ctx = _shard_put(_pad_ctx_rows(ctx, ctx_specs, n_pad), ctx_specs, mesh)
+            ctx, X = self._shard_fit_rows(mesh, base, ctx, X, n_pad)
             row = NamedSharding(mesh, P("data"))
-            row2 = NamedSharding(mesh, P("data", None))
-            X = jax.device_put(_pad_rows(X, n_pad), row2)
             y = jax.device_put(_pad_rows(y, n_pad), row)
             w = jax.device_put(_pad_rows(w, n_pad), row)
             valid_w = jax.device_put(
@@ -387,16 +420,7 @@ class GBMRegressor(_GBMParams):
             build_round_step,
         )
 
-        # per-round bag weights, drawn over the ORIGINAL n rows (bit-identical
-        # to the single-device draw) then zero-padded to the sharded length
-        bag_fn = cached_program(
-            ("gbm_bag", n, n_pad, repl, sub_ratio),
-            lambda: jax.jit(
-                lambda key: _pad_rows(
-                    bootstrap_weights(key, n, repl, sub_ratio), n_pad
-                )
-            ),
-        )
+        bag_fn = self._make_bag_fn(n, n_pad)
 
         eval_loss = cached_program(
             ("gbm_reg_eval", loss_name, alpha_q),
@@ -433,26 +457,11 @@ class GBMRegressor(_GBMParams):
         members, weights = [], []
         i, v = 0, 0
 
-        from spark_ensemble_tpu.utils.checkpoint import (
-            TrainingCheckpointer,
-            run_fingerprint,
-        )
-
-        ckpt = TrainingCheckpointer(
-            self.checkpoint_dir,
-            self.checkpoint_interval,
-            # n_pad is part of the identity: checkpointed `pred` is padded to
-            # the mesh's data-axis size, so a resume under a different mesh
-            # (different n_pad) must start fresh rather than load a
-            # wrong-length prediction state
-            fingerprint=run_fingerprint(
-                type(self).__name__,
-                self._resume_identity(),
-                int(n),
-                int(d),
-                int(n_pad),
-            ),
-        )
+        # n_pad is part of the identity: checkpointed `pred` is padded to
+        # the mesh's data-axis size, so a resume under a different mesh
+        # (different n_pad) must start fresh rather than load a wrong-length
+        # prediction state
+        ckpt = self._checkpointer(n, d, n_pad)
         resumed = ckpt.load_latest()
         if resumed is not None:
             last_round, st = resumed
@@ -567,7 +576,16 @@ class GBMClassifier(_GBMParams):
     def _make_loss(self, num_classes):
         return losses_mod.get_classification_loss(self.loss.lower(), num_classes)
 
-    def fit(self, X, y, sample_weight=None, validation_indicator=None, mesh=None):
+    @instrumented_fit
+    def fit(
+        self,
+        X,
+        y,
+        sample_weight=None,
+        validation_indicator=None,
+        mesh=None,
+        num_classes=None,
+    ):
         """Fit; with ``mesh`` the round runs as one shard_map-ed SPMD program:
         rows sharded over "data" (psum histograms/hessians/objectives), class
         dims block-sharded over "member" with an all_gather to rejoin
@@ -577,7 +595,9 @@ class GBMClassifier(_GBMParams):
         X = as_f32(X)
         y = as_f32(y)
         w_all = resolve_weights(y, sample_weight)
-        num_classes = infer_num_classes(y)
+        # validate over the FULL label set (train + validation) so a
+        # validation fold missing the top class cannot shrink the model
+        num_classes = infer_num_classes(y, num_classes)
         if validation_indicator is not None:
             vi = np.asarray(validation_indicator, bool)
             X_val, y_val = X[vi], y[vi]
@@ -610,13 +630,21 @@ class GBMClassifier(_GBMParams):
             ax = "data"
             n_pad = n + (-n) % data_size
 
-        # init raw scores (`GBMClassifier.scala:275-288`)
+        # init raw scores (`GBMClassifier.scala:275-288`); num_classes is
+        # passed explicitly — the train split may be missing the top class
+        # (validation indicator or CV fold), and the init prior must still
+        # be K-dimensional
         init_model = DummyClassifier(strategy=self.init_strategy).fit(
-            X, y, sample_weight=w
+            X, y, sample_weight=w, num_classes=num_classes
         )
         if dim == 1 and num_classes == 2 and self.init_strategy.lower() == "prior":
+            # clamp BOTH sides: with explicit num_classes a train split can
+            # contain zero positives (p1 == 0), and log(0) = -inf would
+            # poison every raw prediction
             p1 = init_model.params["proba"][1]
-            logodds = jnp.log(p1 / jnp.maximum(1.0 - p1, 1e-30))
+            logodds = jnp.log(
+                jnp.maximum(p1, 1e-30) / jnp.maximum(1.0 - p1, 1e-30)
+            )
             init_raw = logodds[None]
         elif dim == 1:
             init_raw = jnp.zeros((1,), jnp.float32)
@@ -637,13 +665,11 @@ class GBMClassifier(_GBMParams):
 
         # ---- mesh: pad rows, shard row-indexed arrays over "data" --------
         if mesh is not None:
-            ctx_specs = base.ctx_specs(ctx, "data")
-            ctx = _shard_put(_pad_ctx_rows(ctx, ctx_specs, n_pad), ctx_specs, mesh)
-            row = NamedSharding(mesh, P("data"))
-            row2 = NamedSharding(mesh, P("data", None))
-            X = jax.device_put(_pad_rows(X, n_pad), row2)
-            y_enc = jax.device_put(_pad_rows(y_enc, n_pad), row2)
-            w = jax.device_put(_pad_rows(w, n_pad), row)
+            ctx, X = self._shard_fit_rows(mesh, base, ctx, X, n_pad)
+            y_enc = jax.device_put(
+                _pad_rows(y_enc, n_pad), NamedSharding(mesh, P("data", None))
+            )
+            w = jax.device_put(_pad_rows(w, n_pad), NamedSharding(mesh, P("data")))
         pred = jnp.broadcast_to(init_raw[None, :], (n_pad, dim)).astype(jnp.float32)
         if mesh is not None:
             pred = jax.device_put(pred, NamedSharding(mesh, P("data", None)))
@@ -739,14 +765,7 @@ class GBMClassifier(_GBMParams):
         )
         round_step = cached_program(round_key, build_round_step)
 
-        bag_fn = cached_program(
-            ("gbm_bag", n, n_pad, repl, sub_ratio),
-            lambda: jax.jit(
-                lambda key: _pad_rows(
-                    bootstrap_weights(key, n, repl, sub_ratio), n_pad
-                )
-            ),
-        )
+        bag_fn = self._make_bag_fn(n, n_pad)
 
         eval_loss = cached_program(
             ("gbm_cls_eval", loss_name, num_classes),
@@ -773,25 +792,9 @@ class GBMClassifier(_GBMParams):
         members, weights = [], []
         i, v = 0, 0
 
-        from spark_ensemble_tpu.utils.checkpoint import (
-            TrainingCheckpointer,
-            run_fingerprint,
-        )
-
-        ckpt = TrainingCheckpointer(
-            self.checkpoint_dir,
-            self.checkpoint_interval,
-            # n_pad in the identity: see GBMRegressor — padded `pred` must
-            # not be resumed under a mesh with a different data-axis size
-            fingerprint=run_fingerprint(
-                type(self).__name__,
-                self._resume_identity(),
-                int(n),
-                int(d),
-                int(num_classes),
-                int(n_pad),
-            ),
-        )
+        # n_pad in the identity: see GBMRegressor — padded `pred` must not
+        # be resumed under a mesh with a different data-axis size
+        ckpt = self._checkpointer(n, d, num_classes, n_pad)
         resumed = ckpt.load_latest()
         if resumed is not None:
             last_round, st = resumed

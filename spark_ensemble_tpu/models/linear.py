@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from spark_ensemble_tpu.ops.collective import preduce
 from spark_ensemble_tpu.models.base import (
     Static,
     static_value,
@@ -43,17 +44,13 @@ def _apply_mask(X, feature_mask):
     return X * feature_mask.astype(X.dtype)[None, :]
 
 
-def _preduce(x, axis_name):
-    return jax.lax.psum(x, axis_name) if axis_name is not None else x
-
-
 def _feature_stats(X, w, axis_name=None):
     """Weighted per-feature mean and std (std floored; constant/masked
     columns get sd=1 so they contribute nothing and stay solvable).  With
     ``axis_name`` the moments are psum-ed over the mesh data axis."""
-    wsum = jnp.maximum(_preduce(jnp.sum(w), axis_name), 1e-30)
-    mu = _preduce(jnp.sum(w[:, None] * X, axis=0), axis_name) / wsum
-    var = _preduce(
+    wsum = jnp.maximum(preduce(jnp.sum(w), axis_name), 1e-30)
+    mu = preduce(jnp.sum(w[:, None] * X, axis=0), axis_name) / wsum
+    var = preduce(
         jnp.sum(w[:, None] * (X - mu[None, :]) ** 2, axis=0), axis_name
     ) / wsum
     sd = jnp.sqrt(var)
@@ -78,10 +75,10 @@ class LinearRegression(BaseLearner):
         if self.fit_intercept:
             Xs = jnp.concatenate([Xs, jnp.ones((n, 1), X.dtype)], axis=1)
         Xw = Xs * w[:, None]
-        A = _preduce(Xs.T @ Xw, axis_name) + (self.reg_param + 1e-6) * jnp.eye(
+        A = preduce(Xs.T @ Xw, axis_name) + (self.reg_param + 1e-6) * jnp.eye(
             Xs.shape[1], dtype=X.dtype
         )
-        b = _preduce(Xw.T @ y, axis_name)
+        b = preduce(Xw.T @ y, axis_name)
         beta = jax.scipy.linalg.solve(A, b, assume_a="pos")
         coef_s = beta[:d] if self.fit_intercept else beta
         icpt_s = beta[d] if self.fit_intercept else jnp.asarray(0.0, X.dtype)
@@ -152,13 +149,13 @@ class LogisticRegression(BaseLearner):
         mu, sd = _feature_stats(X, w, axis_name)
         Xs = (X - mu[None, :]) / sd[None, :]
         onehot = jax.nn.one_hot(y.astype(jnp.int32), k)
-        w_norm = w / jnp.maximum(_preduce(jnp.sum(w), axis_name), 1e-30)
+        w_norm = w / jnp.maximum(preduce(jnp.sum(w), axis_name), 1e-30)
 
         def objective(theta):
             logits = Xs @ theta["coef"] + theta["intercept"][None, :]
             ce = -jnp.sum(onehot * jax.nn.log_softmax(logits, axis=-1), axis=-1)
             reg = 0.5 * self.reg_param * jnp.sum(theta["coef"] ** 2)
-            return _preduce(jnp.sum(w_norm * ce), axis_name) + reg
+            return preduce(jnp.sum(w_norm * ce), axis_name) + reg
 
         init = {
             "coef": jnp.zeros((d, k), jnp.float32),

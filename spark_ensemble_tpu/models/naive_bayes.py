@@ -12,6 +12,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from spark_ensemble_tpu.ops.collective import preduce as _preduce
 from spark_ensemble_tpu.models.base import (
     Static,
     static_value,
@@ -31,8 +32,7 @@ class GaussianNaiveBayes(BaseLearner):
         return {"X": as_f32(X), "num_classes": Static(num_classes)}
 
     def fit_from_ctx(self, ctx, y, w, feature_mask, key, axis_name=None):
-        def preduce(v):
-            return jax.lax.psum(v, axis_name) if axis_name is not None else v
+        preduce = lambda v: _preduce(v, axis_name)
 
         X = ctx["X"]
         k = static_value(ctx["num_classes"])

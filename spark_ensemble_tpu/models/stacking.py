@@ -43,6 +43,7 @@ from spark_ensemble_tpu.models.tree import (
     DecisionTreeRegressor,
 )
 from spark_ensemble_tpu.params import Param, in_array
+from spark_ensemble_tpu.utils.instrumentation import instrumented_fit
 
 logger = logging.getLogger(__name__)
 
@@ -65,6 +66,7 @@ class StackingRegressor(_StackingParams):
     def _stacker(self) -> BaseLearner:
         return self.stacker or LinearRegression()
 
+    @instrumented_fit
     def fit(self, X, y, sample_weight=None) -> "StackingRegressionModel":
         X, y = as_f32(X), as_f32(y)
         w = resolve_weights(y, sample_weight)
@@ -124,9 +126,13 @@ class StackingClassifier(_StackingParams):
                 cols.append(m.predict(X)[:, None])
         return jnp.concatenate(cols, axis=1)
 
-    def fit(self, X, y, sample_weight=None) -> "StackingClassificationModel":
+    @instrumented_fit
+    def fit(
+        self, X, y, sample_weight=None, num_classes=None
+    ) -> "StackingClassificationModel":
         X, y = as_f32(X), as_f32(y)
         w = resolve_weights(y, sample_weight)
+        num_classes = infer_num_classes(y, num_classes)
         models = []
         for base in self._bases():
             sw = w if base.supports_weight else None
@@ -135,14 +141,24 @@ class StackingClassifier(_StackingParams):
                     "base learner %s does not support weights; ignoring",
                     type(base).__name__,
                 )
-            models.append(base.fit(X, y, sample_weight=sw))
+            if base.is_classifier:
+                models.append(
+                    base.fit(X, y, sample_weight=sw, num_classes=num_classes)
+                )
+            else:
+                models.append(base.fit(X, y, sample_weight=sw))
         meta = self._meta_features(models, X)
-        stack_model = self._stacker().fit(meta, y, sample_weight=w)
+        stacker = self._stacker()
+        stack_model = (
+            stacker.fit(meta, y, sample_weight=w, num_classes=num_classes)
+            if stacker.is_classifier
+            else stacker.fit(meta, y, sample_weight=w)
+        )
         return StackingClassificationModel(
             base_models=models,
             stack_model=stack_model,
             num_features=X.shape[1],
-            num_classes=infer_num_classes(y),
+            num_classes=num_classes,
             **self.get_params(),
         )
 

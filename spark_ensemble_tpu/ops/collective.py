@@ -1,0 +1,19 @@
+"""Shared collective helpers for SPMD kernels.
+
+Every base learner psums its sufficient statistics over the mesh data axis
+when fitting inside ``shard_map`` (the XLA stand-in for Spark executors
+aggregating per-partition statistics with ``treeAggregate``,
+`GBMClassifier.scala:344-355`).  One helper so the psum-or-identity logic
+cannot silently diverge between learners.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def preduce(x, axis_name: Optional[str]):
+    """``psum`` over ``axis_name`` inside shard_map; identity when unsharded."""
+    return jax.lax.psum(x, axis_name) if axis_name is not None else x

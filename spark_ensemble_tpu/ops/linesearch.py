@@ -23,6 +23,8 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
+from spark_ensemble_tpu.ops.collective import preduce
+
 _CGOLD = 0.3819660112501051  # golden-section fraction
 
 
@@ -136,8 +138,7 @@ def projected_newton_box(
     """
     k = x0.shape[0]
 
-    def red(v):
-        return jax.lax.psum(v, axis_name) if axis_name is not None else v
+    red = lambda v: preduce(v, axis_name)
 
     fval = lambda x: red(f(x))
     grad_f = lambda x: red(jax.grad(f)(x))

@@ -111,7 +111,16 @@ class Pipeline(Estimator):
 
     stages = Param(None, is_estimator=True)
 
-    def fit(self, X, y=None, sample_weight=None) -> "PipelineModel":
+    @property
+    def is_classifier(self):
+        """A pipeline classifies iff some estimator stage does — keeps the
+        num_classes plumbing (tuning folds missing the top class) working
+        for tuned Pipelines too."""
+        return any(
+            getattr(s, "is_classifier", False) for s in (self.stages or [])
+        )
+
+    def fit(self, X, y=None, sample_weight=None, num_classes=None) -> "PipelineModel":
         fitted: List[Any] = []
         Xc = as_f32(X)
         num_features = Xc.shape[1]
@@ -124,7 +133,12 @@ class Pipeline(Estimator):
                 if hasattr(stage, "transform"):
                     Xc = stage.transform(Xc)
             elif isinstance(stage, Estimator):
-                model = stage.fit(Xc, y, sample_weight=sample_weight)
+                if getattr(stage, "is_classifier", False):
+                    model = stage.fit(
+                        Xc, y, sample_weight=sample_weight, num_classes=num_classes
+                    )
+                else:
+                    model = stage.fit(Xc, y, sample_weight=sample_weight)
                 fitted.append(model)
                 if hasattr(model, "transform"):
                     Xc = model.transform(Xc)
@@ -156,8 +170,12 @@ class PipelineModel(Model, Pipeline):
 
     def _features(self, X):
         Xc = as_f32(X)
+        # mirror fit(): a non-final stage without `transform` (e.g. a fitted
+        # predictor mid-pipeline) passes features through unchanged, so
+        # predict() matches fit-time feature flow instead of raising
         for stage in self.stage_models[:-1]:
-            Xc = stage.transform(Xc)
+            if hasattr(stage, "transform"):
+                Xc = stage.transform(Xc)
         return Xc
 
     @property

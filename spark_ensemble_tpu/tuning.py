@@ -65,11 +65,26 @@ def _kfold_indices(n: int, num_folds: int, seed: int) -> List[np.ndarray]:
     return folds
 
 
-def _fit_and_eval(estimator, pmap, evaluator, X, y, w, train_mask, eval_mask):
+def _full_num_classes(estimator, y):
+    """Class count over the FULL label set, computed once per search: a
+    fold's train split may miss the top class.  None for regressors."""
+    if not getattr(estimator, "is_classifier", False):
+        return None
+    from spark_ensemble_tpu.models.base import infer_num_classes
+
+    return infer_num_classes(y)
+
+
+def _fit_and_eval(
+    estimator, pmap, evaluator, X, y, w, train_mask, eval_mask, num_classes=None
+):
     est = estimator.copy(**pmap)
     Xt, yt = X[train_mask], y[train_mask]
     wt = w[train_mask] if w is not None else None
-    model = est.fit(Xt, yt, sample_weight=wt)
+    if num_classes is not None:
+        model = est.fit(Xt, yt, sample_weight=wt, num_classes=num_classes)
+    else:
+        model = est.fit(Xt, yt, sample_weight=wt)
     Xe, ye = X[eval_mask], y[eval_mask]
     we = w[eval_mask] if w is not None else None
     return model, evaluator.evaluate(model, Xe, ye, sample_weight=we)
@@ -99,11 +114,13 @@ class CrossValidator(_TuningParams):
         maps = self._maps()
         folds = _kfold_indices(X.shape[0], self.num_folds, self.seed)
         metrics = np.zeros((len(maps), self.num_folds))
+        k = _full_num_classes(self.estimator, y)
         for fi, eval_mask in enumerate(folds):
             train_mask = ~eval_mask
             for mi, pmap in enumerate(maps):
                 _, metric = _fit_and_eval(
-                    self.estimator, pmap, evaluator, X, y, w, train_mask, eval_mask
+                    self.estimator, pmap, evaluator, X, y, w, train_mask,
+                    eval_mask, num_classes=k,
                 )
                 metrics[mi, fi] = metric
                 logger.info("CV fold %d map %d: %.5f", fi, mi, metric)
@@ -163,9 +180,11 @@ class TrainValidationSplit(_TuningParams):
         train_mask[perm[:n_train]] = True
         eval_mask = ~train_mask
         metrics = np.zeros((len(maps),))
+        k = _full_num_classes(self.estimator, y)
         for mi, pmap in enumerate(maps):
             _, metric = _fit_and_eval(
-                self.estimator, pmap, evaluator, X, y, w, train_mask, eval_mask
+                self.estimator, pmap, evaluator, X, y, w, train_mask,
+                eval_mask, num_classes=k,
             )
             metrics[mi] = metric
             logger.info("TVS map %d: %.5f", mi, metric)

@@ -13,6 +13,7 @@ no profiler integration; tests used ``spark.time`` wall-clock prints).
 from __future__ import annotations
 
 import contextlib
+import functools
 import logging
 import time
 from typing import Any, Dict, Iterator, Optional
@@ -66,3 +67,20 @@ def profile_trace(log_dir: Optional[str]) -> Iterator[None]:
 
     with jax.profiler.trace(log_dir):
         yield
+
+
+def instrumented_fit(fit):
+    """Decorator for estimator ``fit`` methods: runs the body inside the
+    ``instrumented`` logging scope (exception logging, like the reference's
+    ``instrumented { ... }`` wrapper) and — when the estimator's
+    ``profile_dir`` param is set — a ``jax.profiler`` trace capture of the
+    whole fit (TensorBoard-viewable timeline; SURVEY.md §5 tracing row)."""
+
+    @functools.wraps(fit)
+    def wrapper(self, *args, **kwargs):
+        with instrumented(f"{type(self).__name__}.fit"), profile_trace(
+            getattr(self, "profile_dir", None)
+        ):
+            return fit(self, *args, **kwargs)
+
+    return wrapper

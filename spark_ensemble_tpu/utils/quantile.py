@@ -23,13 +23,20 @@ import jax
 import jax.numpy as jnp
 
 
-def weighted_median(values: jax.Array, weights: jax.Array) -> jax.Array:
+def weighted_median(
+    values: jax.Array, weights: jax.Array, axis_name: Optional[str] = None
+) -> jax.Array:
     """First value (in sorted order) whose cumulative weight >= total/2.
 
     Matches `Utils.scala:26-40` exactly, including the >= comparison.
     Zero-weight entries cannot be selected unless they tie with the crossing
     point, mirroring the reference's behavior under its property tests.
+    With ``axis_name`` (inside shard_map) shards are all-gathered first so
+    every shard computes the identical global median.
     """
+    if axis_name is not None:
+        values = jax.lax.all_gather(values, axis_name, tiled=True)
+        weights = jax.lax.all_gather(weights, axis_name, tiled=True)
     order = jnp.argsort(values)
     v = values[order]
     w = weights[order]

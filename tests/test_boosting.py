@@ -93,3 +93,29 @@ def test_degenerate_constant_labels_stop_early():
     boost = se.BoostingRegressor(num_base_learners=10).fit(X, y)
     assert boost.num_members == 1
     assert np.allclose(np.asarray(boost.predict(X[:10])), 2.5, atol=1e-4)
+
+
+def test_round_program_not_stale_after_set_params():
+    """Regression (ADVICE r1): the cached round-step program must not read
+    `self.loss` at retrace time.  Mutating one estimator's loss after fit
+    must not corrupt a later same-config fit that retraces under new
+    shapes."""
+    from spark_ensemble_tpu.models.base import _PROGRAM_CACHE
+
+    rng = np.random.RandomState(7)
+    X1 = rng.randn(200, 4).astype(np.float32)
+    y1 = (X1[:, 0] + 0.1 * rng.randn(200)).astype(np.float32)
+    X2 = rng.randn(333, 4).astype(np.float32)  # new shape -> retrace
+    y2 = (X2[:, 0] + 0.1 * rng.randn(333)).astype(np.float32)
+
+    est_a = se.BoostingRegressor(loss="exponential", num_base_learners=3, seed=1)
+    est_a.fit(X1, y1)  # caches the 'exponential' round program
+    est_a.set_params(loss="squared")  # mutation after fit
+
+    est_b = se.BoostingRegressor(loss="exponential", num_base_learners=3, seed=1)
+    got = np.asarray(est_b.fit(X2, y2).predict(X2[:50]))
+
+    _PROGRAM_CACHE.clear()  # ground truth from an untainted program
+    fresh = se.BoostingRegressor(loss="exponential", num_base_learners=3, seed=1)
+    want = np.asarray(fresh.fit(X2, y2).predict(X2[:50]))
+    assert np.allclose(got, want, atol=1e-5)

@@ -33,6 +33,48 @@ def test_checkpointer_roundtrip(tmp_path):
     assert ck.load_latest() is None
 
 
+def _resume_vs_full(tmp_path, make_est, X, y, n_full=6, n_part=4):
+    """Shared harness: fit n_full rounds straight vs interrupted-at-n_part +
+    resumed; final models must predict identically."""
+    ckdir = str(tmp_path / "ck")
+    full = make_est(num_base_learners=n_full).fit(X, y)
+    est = make_est(
+        num_base_learners=n_part, checkpoint_dir=ckdir, checkpoint_interval=n_part
+    )
+    orig_delete = TrainingCheckpointer.delete
+    TrainingCheckpointer.delete = lambda self: None
+    try:
+        est.fit(X, y)
+    finally:
+        TrainingCheckpointer.delete = orig_delete
+    import os
+
+    assert os.path.exists(os.path.join(ckdir, "latest", "state.json"))
+    resumed = make_est(
+        num_base_learners=n_full, checkpoint_dir=ckdir, checkpoint_interval=100
+    ).fit(X, y)
+    a = np.asarray(full.predict(X[:100]))
+    b = np.asarray(resumed.predict(X[:100]))
+    assert resumed.num_members == full.num_members
+    assert np.allclose(a, b, atol=1e-4), np.abs(a - b).max()
+
+
+def test_boosting_regressor_resume_matches_uninterrupted(tmp_path):
+    X, y = _data()
+    _resume_vs_full(
+        tmp_path, lambda **kw: se.BoostingRegressor(seed=3, loss="linear", **kw), X, y
+    )
+
+
+def test_boosting_classifier_resume_matches_uninterrupted(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.randn(600, 5).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    _resume_vs_full(
+        tmp_path, lambda **kw: se.BoostingClassifier(seed=3, **kw), X, y
+    )
+
+
 def test_gbm_resume_matches_uninterrupted(tmp_path):
     """Fit 6 rounds straight vs fit interrupted at round 4 + resumed: the
     final models must predict identically."""

@@ -49,6 +49,18 @@ def test_regression_metrics_match_numpy():
     assert RegressionEvaluator(metric="r2").is_larger_better is True
 
 
+def test_regression_var_is_explained_variance():
+    """Spark 'var' = SSreg / weightSum (explained variance), larger-better."""
+    rng = np.random.RandomState(2)
+    y = rng.randn(300).astype(np.float32)
+    pred = 0.5 * y + 0.1 * rng.randn(300).astype(np.float32)
+    model = _FixedModel(pred=pred)
+    got = RegressionEvaluator(metric="var").evaluate(model, np.zeros((300, 1)), y)
+    expect = np.mean((pred - np.mean(y)) ** 2)
+    assert got == pytest.approx(expect, rel=1e-4)
+    assert RegressionEvaluator(metric="var").is_larger_better is True
+
+
 def test_regression_weighted():
     y = np.array([0.0, 0.0], np.float32)
     pred = np.array([1.0, 3.0], np.float32)
@@ -114,6 +126,17 @@ def test_binary_auc_perfect_and_random():
     )
     base_rate = float(np.mean(y))
     assert abs(pr - base_rate) < 0.1
+
+
+def test_aupr_constant_scorer_is_base_rate():
+    """SPARK-21806 anchor: a constant scorer's AUPR equals the base rate,
+    not (1 + baseRate) / 2 as the (0, 1) anchor would give."""
+    y = np.array([1.0] * 30 + [0.0] * 70, np.float32)
+    proba = np.full((100, 2), 0.5, np.float32)
+    pr = BinaryClassificationEvaluator(metric="areaUnderPR").evaluate(
+        _FixedModel(proba=proba), np.zeros((100, 1)), y
+    )
+    assert pr == pytest.approx(0.3, abs=1e-6)
 
 
 def test_binary_auc_tied_scores_give_chance_level():

@@ -147,3 +147,47 @@ def test_gbm_init_strategies(cpusmall):
         )
         base = rmse(np.full_like(yte, float(np.mean(ytr))), yte)
         assert rmse(gbm.predict(Xte), yte) < base
+
+
+def test_gbm_classifier_validation_fold_missing_top_class():
+    """Regression: the init DummyClassifier must be sized by the explicit
+    class count even when the train split is missing the top class."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(200, 4).astype(np.float32)
+    y = np.where(X[:, 0] > 0, 1.0, 0.0).astype(np.float32)
+    y[:8] = 2.0
+    vi = np.zeros(200, bool)
+    vi[:8] = True  # every class-2 row held out for validation
+    model = se.GBMClassifier(num_base_learners=2).fit(
+        X, y, validation_indicator=vi
+    )
+    assert model.num_classes == 3
+    assert model.predict_raw(X[:5]).shape == (5, 3)
+
+
+def test_gbm_with_dummy_base_learner():
+    """Regression: every BaseLearner must accept the axis_name kwarg the
+    GBM round passes (DummyRegressor missed it when the mesh path landed)."""
+    rng = np.random.RandomState(1)
+    X = rng.randn(150, 3).astype(np.float32)
+    y = (X[:, 0] + 0.1 * rng.randn(150)).astype(np.float32)
+    model = se.GBMRegressor(
+        base_learner=se.DummyRegressor(strategy="mean"), num_base_learners=2
+    ).fit(X, y)
+    assert np.all(np.isfinite(np.asarray(model.predict(X[:5]))))
+
+
+def test_gbm_classifier_binary_prior_with_no_positives_in_train():
+    """Regression: explicit num_classes with zero train positives must give
+    a finite (clamped) log-odds init, not -inf."""
+    rng = np.random.RandomState(2)
+    X = rng.randn(120, 3).astype(np.float32)
+    y = np.zeros(120, np.float32)
+    y[100:] = 1.0
+    vi = np.zeros(120, bool)
+    vi[100:] = True  # all positives held out
+    model = se.GBMClassifier(num_base_learners=2, loss="bernoulli").fit(
+        X, y, validation_indicator=vi
+    )
+    raw = np.asarray(model.predict_raw(X[:5]))
+    assert np.all(np.isfinite(raw)), raw

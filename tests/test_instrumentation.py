@@ -1,0 +1,51 @@
+"""Instrumentation + profiler hooks (SURVEY.md §5 tracing row)."""
+
+import logging
+import os
+
+import numpy as np
+
+import spark_ensemble_tpu as se
+
+
+def _data(n=200, d=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] + 0.1 * rng.randn(n)).astype(np.float32)
+    return X, y
+
+
+def test_fit_logs_params_and_outcome(caplog):
+    X, y = _data()
+    with caplog.at_level(logging.INFO, logger="spark_ensemble_tpu"):
+        se.GBMRegressor(num_base_learners=2).fit(X, y)
+    text = caplog.text
+    assert "GBMRegressor.fit] params" in text
+    assert "dataset: n=200, d=4" in text
+    assert "done in" in text
+
+
+def test_profile_dir_produces_trace(tmp_path):
+    """profile_dir param activates a jax.profiler trace capture around fit."""
+    trace_dir = str(tmp_path / "trace")
+    X, y = _data()
+    se.GBMRegressor(num_base_learners=2, profile_dir=trace_dir).fit(X, y)
+    assert os.path.isdir(trace_dir)
+    found = [
+        os.path.join(root, f)
+        for root, _, files in os.walk(trace_dir)
+        for f in files
+    ]
+    assert found, "profiler trace directory is empty"
+
+
+def test_instrumented_logs_exceptions(caplog):
+    import pytest
+
+    from spark_ensemble_tpu.utils.instrumentation import instrumented
+
+    with caplog.at_level(logging.ERROR, logger="spark_ensemble_tpu"):
+        with pytest.raises(RuntimeError):
+            with instrumented("boom.fit"):
+                raise RuntimeError("x")
+    assert "[boom.fit] failed" in caplog.text

@@ -1,14 +1,22 @@
 """Distributed-path tests on the 8-device virtual CPU mesh (the analogue of
-the reference testing "distributed" via local-mode Spark, SURVEY.md §4)."""
+the reference testing "distributed" via local-mode Spark, SURVEY.md §4).
+
+The sharded GBM round under test is the ESTIMATOR mesh path itself
+(`GBMClassifier.fit(mesh=...)` — rows over "data" with psum-ed histograms,
+class dims over "member" with all_gather); kernel-level split decisions are
+checked bit-for-bit against single-device ``fit_tree`` since psum-ed
+histograms are exact sums of the same addends per node."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from spark_ensemble_tpu import GBMClassifier
+from spark_ensemble_tpu.models.tree import DecisionTreeRegressor
 from spark_ensemble_tpu.ops.binning import bin_features, compute_bins
 from spark_ensemble_tpu.ops.losses import LogLoss
-from spark_ensemble_tpu.parallel.distributed import make_sharded_gbm_round
+from spark_ensemble_tpu.ops.tree import fit_tree
 from spark_ensemble_tpu.parallel.mesh import create_mesh, pad_to_multiple
 
 
@@ -17,7 +25,7 @@ def _toy(n=512, d=6, k=4, seed=0):
     X = rng.randn(n, d).astype(np.float32)
     centers = rng.randn(k, d).astype(np.float32)
     y = np.argmax(X @ centers.T + 0.3 * rng.randn(n, k), axis=1).astype(np.float32)
-    return jnp.asarray(X), jnp.asarray(y)
+    return X, y
 
 
 @pytest.fixture(scope="module")
@@ -29,60 +37,57 @@ def mesh():
 
 def test_sharded_round_reduces_loss(mesh):
     X, y = _toy()
-    k = 4
-    loss = LogLoss(k)
-    bins = compute_bins(X, 16)
-    Xb = bin_features(X, bins)
-    y_enc = loss.encode_label(y)
-    pred = jnp.zeros((X.shape[0], k))
-    w = jnp.ones(X.shape[0])
-    round_fn = make_sharded_gbm_round(
-        mesh, loss, max_depth=3, max_bins=16, updates="newton"
+    est = GBMClassifier(
+        num_base_learners=1,
+        loss="logloss",
+        updates="newton",
+        base_learner=DecisionTreeRegressor(max_depth=3, max_bins=16),
     )
-    trees, step_w, new_pred = round_fn(Xb, bins.thresholds, y_enc, pred, w, w)
-    before = float(jnp.mean(loss.loss(y_enc, pred)))
-    after = float(jnp.mean(loss.loss(y_enc, new_pred)))
+    model = est.fit(X, y, mesh=mesh)
+    loss = LogLoss(4)
+    y_enc = loss.encode_label(jnp.asarray(y))
+    before = float(jnp.mean(loss.loss(y_enc, jnp.zeros((X.shape[0], 4)))))
+    after = float(jnp.mean(loss.loss(y_enc, model.predict_raw(jnp.asarray(X)))))
     assert after < before
-    assert step_w.shape == (k,)
-    assert bool(jnp.all(step_w >= 0))
+    w = np.asarray(model.params["weights"])
+    assert w.shape == (1, 4)
+    assert np.all(w >= 0)
 
 
-def test_sharded_round_matches_unsharded(mesh):
-    """DP x MP GBM round == the single-device round step, bit-for-bit on
+def test_sharded_round_matches_unsharded_splits(mesh):
+    """DP x MP estimator round == single-device ``fit_tree``, bit-for-bit on
     split decisions (psum-ed histograms are exact sums)."""
-    from spark_ensemble_tpu.ops.tree import fit_tree
-
     X, y = _toy(n=256)
     k = 4
-    loss = LogLoss(k)
-    bins = compute_bins(X, 16)
-    Xb = bin_features(X, bins)
-    y_enc = loss.encode_label(y)
-    pred = jnp.zeros((X.shape[0], k))
-    w = jnp.ones(X.shape[0])
-
-    round_fn = make_sharded_gbm_round(
-        mesh, loss, max_depth=3, max_bins=16, updates="gradient",
+    cfg = dict(
+        num_base_learners=1,
+        loss="logloss",
+        updates="gradient",
         optimized_weights=False,
+        base_learner=DecisionTreeRegressor(max_depth=3, max_bins=16),
+        seed=9,
     )
-    trees_sh, step_sh, pred_sh = round_fn(Xb, bins.thresholds, y_enc, pred, w, w)
+    dist = GBMClassifier(**cfg).fit(X, y, mesh=mesh)
+    trees_sh = dist.params["members"]  # stacked [1, k] member pytree
 
     # single-device reference: same pseudo-residuals, same per-class trees
+    loss = LogLoss(k)
+    Xj = jnp.asarray(X)
+    bins = compute_bins(Xj, 16)
+    Xb = bin_features(Xj, bins)
+    y_enc = loss.encode_label(jnp.asarray(y))
+    # init raw = log prior, as the estimator's prior init produces
+    init_raw = dist.params["init_raw"]
+    pred = jnp.broadcast_to(init_raw[None, :], (X.shape[0], k))
     neg_grad = loss.negative_gradient(y_enc, pred)
-    fit_one = lambda j: fit_tree(
-        Xb, neg_grad[:, j : j + 1], w, bins.thresholds, max_depth=3, max_bins=16
-    )
+    w = jnp.ones(X.shape[0])
     for j in range(k):
-        single = fit_one(j)
-        assert jnp.array_equal(
-            jax.tree_util.tree_map(lambda x: x[j], trees_sh).split_feature,
-            single.split_feature,
+        single = fit_tree(
+            Xb, neg_grad[:, j : j + 1], w, bins.thresholds, max_depth=3, max_bins=16
         )
-        assert jnp.allclose(
-            jax.tree_util.tree_map(lambda x: x[j], trees_sh).leaf_value,
-            single.leaf_value,
-            atol=1e-4,
-        )
+        member_j = jax.tree_util.tree_map(lambda x: x[0, j], trees_sh)
+        assert jnp.array_equal(member_j.split_feature, single.split_feature), j
+        assert jnp.allclose(member_j.leaf_value, single.leaf_value, atol=1e-4), j
 
 
 def test_pad_to_multiple():

@@ -151,3 +151,28 @@ def test_pipeline_save_load(tmp_path, cpusmall):
     np.testing.assert_allclose(
         np.asarray(model.predict(X_te)), np.asarray(loaded.predict(X_te)), rtol=1e-5
     )
+
+
+def test_cv_pipeline_fold_missing_top_class():
+    """A tuned Pipeline gets the full label set's class count even when a
+    training fold lacks the top class (num_classes plumbing through
+    Pipeline.fit)."""
+    import numpy as np
+
+    import spark_ensemble_tpu as se
+    from spark_ensemble_tpu.pipeline import Pipeline, StandardScaler
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(120, 4).astype(np.float32)
+    y = np.where(X[:, 0] > 0, 1.0, 0.0).astype(np.float32)
+    y[:3] = 2.0  # rare top class: some folds won't see it
+    pipe = Pipeline(stages=[StandardScaler(), se.DecisionTreeClassifier(max_depth=3)])
+    assert pipe.is_classifier
+    cv = se.CrossValidator(
+        estimator=pipe,
+        estimator_param_maps=[{}],
+        evaluator=se.MulticlassClassificationEvaluator(metric="accuracy"),
+        num_folds=4,
+    )
+    model = cv.fit(X, y)
+    assert model.best_model.num_classes == 3

@@ -75,3 +75,77 @@ def test_bootstrap_weights_bernoulli():
     w = bootstrap_weights(jax.random.PRNGKey(0), 20000, False, 0.4)
     assert set(np.unique(np.asarray(w))) <= {0.0, 1.0}
     assert float(jnp.mean(w)) == pytest.approx(0.4, rel=0.05)
+
+
+def test_infer_num_classes_validation():
+    """Label validation parity (`BoostingClassifier.scala:152-161`): labels
+    must be finite non-negative integers, optionally within [0, K)."""
+    import pytest
+
+    from spark_ensemble_tpu.models.base import infer_num_classes
+
+    assert infer_num_classes([0, 1, 2]) == 3
+    assert infer_num_classes([0, 0, 0]) == 2  # degenerate: still binary-shaped
+    assert infer_num_classes([0, 1], num_classes=5) == 5
+    with pytest.raises(ValueError, match="non-negative integers"):
+        infer_num_classes([0.5, 1.0])
+    with pytest.raises(ValueError, match="non-negative integers"):
+        infer_num_classes([-1, 0, 1])
+    with pytest.raises(ValueError, match="finite"):
+        infer_num_classes([0.0, float("nan")])
+    with pytest.raises(ValueError, match="num_classes"):
+        infer_num_classes([0, 1, 4], num_classes=3)
+
+
+def test_classifier_fit_rejects_bad_labels():
+    import numpy as np
+    import pytest
+
+    import spark_ensemble_tpu as se
+
+    X = np.random.RandomState(0).randn(50, 3).astype(np.float32)
+    y_bad = np.linspace(0, 1, 50).astype(np.float32)
+    with pytest.raises(ValueError, match="non-negative integers"):
+        se.BoostingClassifier(num_base_learners=2).fit(X, y_bad)
+    # explicit num_classes sizes the model even when the top class is absent
+    y = (X[:, 0] > 0).astype(np.float32)
+    m = se.BaggingClassifier(num_base_learners=2).fit(X, y, num_classes=3)
+    assert m.num_classes == 3
+    assert m.predict_raw(X[:5]).shape == (5, 3)
+
+
+def test_feature_metadata_propagates_through_subspaces(tmp_path):
+    """`Utils.getFeaturesMetadata` analogue (`Utils.scala:42-61`): names
+    re-index through member subspace masks and survive save/load."""
+    import numpy as np
+
+    import spark_ensemble_tpu as se
+    from spark_ensemble_tpu.utils.features import FeatureMetadata
+
+    md = FeatureMetadata.resolve(["a", "b", "c", "d"], 4)
+    assert md.select(np.array([True, False, True, False])).names == ["a", "c"]
+    assert md.select(np.array([3, 1])).names == ["d", "b"]
+    assert FeatureMetadata.default(2).names == ["f0", "f1"]
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 6).astype(np.float32)
+    y = (X[:, 0] + 0.1 * rng.randn(300)).astype(np.float32)
+    names = [f"col{i}" for i in range(6)]
+    model = se.BaggingRegressor(
+        num_base_learners=3, subspace_ratio=0.5, feature_names=names
+    ).fit(X, y)
+    masks = np.asarray(model.params["masks"])
+    for i in range(3):
+        assert model.member_feature_names(i) == [
+            n for n, m in zip(names, masks[i]) if m
+        ]
+    path = str(tmp_path / "m")
+    model.save(path)
+    loaded = se.load(path)
+    assert loaded.feature_names == names
+    assert loaded.member_feature_names(0) == model.member_feature_names(0)
+
+    import pytest
+
+    with pytest.raises(ValueError, match="feature_names"):
+        _ = se.DecisionTreeRegressor(feature_names=["x"]).fit(X, y).feature_metadata
