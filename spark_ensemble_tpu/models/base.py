@@ -32,6 +32,7 @@ drop weights, like `StackingClassifier.scala:147-150`.
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Any, Callable, Optional
 
@@ -80,6 +81,7 @@ def static_value(v):
 # programs hold device buffers for constants.
 _PROGRAM_CACHE: "OrderedDict[tuple, Callable]" = OrderedDict()
 _PROGRAM_CACHE_SIZE = 128
+_PROGRAM_CACHE_LOCK = threading.Lock()
 
 
 def cached_program(key: tuple, build: Callable[[], Callable]) -> Callable:
@@ -87,16 +89,24 @@ def cached_program(key: tuple, build: Callable[[], Callable]) -> Callable:
 
     ``build`` must return an already-jitted callable whose trace depends
     only on information captured in ``key`` (plus argument shapes/dtypes,
-    which jax.jit handles itself).
+    which jax.jit handles itself).  Thread-safe: concurrent member fits
+    (stacking's driver-Future analogue) may race on the cache.
     """
-    fn = _PROGRAM_CACHE.get(key)
-    if fn is None:
-        fn = build()
+    with _PROGRAM_CACHE_LOCK:
+        fn = _PROGRAM_CACHE.get(key)
+        if fn is not None:
+            _PROGRAM_CACHE.move_to_end(key)
+            return fn
+    fn = build()
+    with _PROGRAM_CACHE_LOCK:
+        existing = _PROGRAM_CACHE.get(key)
+        if existing is not None:
+            # lost a build race: keep the winner, but refresh its LRU slot
+            _PROGRAM_CACHE.move_to_end(key)
+            return existing
         _PROGRAM_CACHE[key] = fn
         while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_SIZE:
             _PROGRAM_CACHE.popitem(last=False)
-    else:
-        _PROGRAM_CACHE.move_to_end(key)
     return fn
 
 

@@ -118,13 +118,33 @@ def _shard_put(tree, specs, mesh: Mesh):
     return jax.device_put(tree, shardings)
 
 
+def _mesh_row_axes(mesh: Mesh):
+    """Mesh axes rows shard over: ("dcn_data", "data") on a multi-slice
+    hybrid mesh (`parallel/mesh.py:hybrid_data_member_mesh`) — row
+    reductions then psum over BOTH, i.e. a fast ICI reduction per slice
+    plus one cross-slice DCN hop — else just ("data",)."""
+    if "dcn_data" in mesh.axis_names:
+        return ("dcn_data", "data")
+    return ("data",)
+
+
 def _mesh_sizes(mesh: Mesh):
     if "data" not in mesh.axis_names:
         raise ValueError(
             f"mesh must have a 'data' axis; got axes {mesh.axis_names}"
         )
     member = int(mesh.shape.get("member", 1))
-    return int(mesh.shape["data"]), member
+    data = 1
+    for a in _mesh_row_axes(mesh):
+        data *= int(mesh.shape[a])
+    return data, member
+
+
+def _mesh_row_spec(mesh: Mesh):
+    """PartitionSpec entry (and psum axis_name) for the row axis: the plain
+    string "data", or the ("dcn_data", "data") tuple on a hybrid mesh."""
+    axes = _mesh_row_axes(mesh)
+    return axes if len(axes) > 1 else "data"
 
 
 def index_pytree(tree: Any, i):
@@ -201,11 +221,17 @@ class _GBMParams(CheckpointableParams, Estimator):
     @staticmethod
     def _shard_fit_rows(mesh: Mesh, base: BaseLearner, ctx, X, n_pad: int):
         """Pad the fit ctx and feature matrix to the data-axis size and
-        device_put them row-sharded over "data"."""
-        ctx_specs = base.ctx_specs(ctx, "data")
-        ctx = _shard_put(_pad_ctx_rows(ctx, ctx_specs, n_pad), ctx_specs, mesh)
+        device_put them row-sharded (over "data", or ("dcn_data", "data")
+        on a hybrid multi-slice mesh)."""
+        row_spec = _mesh_row_spec(mesh)
+        ctx_specs = base.ctx_specs(ctx, row_spec)
+        ctx = _shard_put(
+            _pad_ctx_rows(ctx, ctx_specs, n_pad, data_axis=row_spec),
+            ctx_specs,
+            mesh,
+        )
         X = jax.device_put(
-            _pad_rows(X, n_pad), NamedSharding(mesh, P("data", None))
+            _pad_rows(X, n_pad), NamedSharding(mesh, P(row_spec, None))
         )
         return ctx, X
 
@@ -317,10 +343,10 @@ class GBMRegressor(_GBMParams):
         n_pad = n
         if mesh is not None:
             data_size, _ = _mesh_sizes(mesh)
-            ax = "data"
+            ax = _mesh_row_spec(mesh)
             n_pad = n + (-n) % data_size
             ctx, X = self._shard_fit_rows(mesh, base, ctx, X, n_pad)
-            row = NamedSharding(mesh, P("data"))
+            row = NamedSharding(mesh, P(ax))
             y = jax.device_put(_pad_rows(y, n_pad), row)
             w = jax.device_put(_pad_rows(w, n_pad), row)
             valid_w = jax.device_put(
@@ -387,17 +413,17 @@ class GBMRegressor(_GBMParams):
                     round_core,
                     mesh=mesh,
                     in_specs=(
-                        base.ctx_specs(ctx, "data"),
-                        P("data", None),  # X
-                        P("data"),  # bag_w
+                        base.ctx_specs(ctx, ax),
+                        P(ax, None),  # X
+                        P(ax),  # bag_w
                         P(),  # key
                         P(),  # mask
-                        P("data"),  # pred
+                        P(ax),  # pred
                         P(),  # delta
-                        P("data"),  # y
-                        P("data"),  # w
+                        P(ax),  # y
+                        P(ax),  # w
                     ),
-                    out_specs=(P(), P(), P("data")),
+                    out_specs=(P(), P(), P(ax)),
                     check_vma=False,
                 )
             )
@@ -468,7 +494,9 @@ class GBMRegressor(_GBMParams):
             i, v, best = last_round + 1, int(st["v"]), float(st["best"])
             pred = jnp.asarray(st["pred"])
             if mesh is not None:
-                pred = jax.device_put(pred, NamedSharding(mesh, P("data")))
+                pred = jax.device_put(
+                    pred, NamedSharding(mesh, P(_mesh_row_spec(mesh)))
+                )
             pred_val = st.get("pred_val")
             members = list(st["members"])
             weights = [jnp.asarray(x) for x in st["weights"]]
@@ -627,7 +655,7 @@ class GBMClassifier(_GBMParams):
                     f"class dim {dim} must be divisible by the 'member' mesh "
                     f"axis size {member_size}"
                 )
-            ax = "data"
+            ax = _mesh_row_spec(mesh)
             n_pad = n + (-n) % data_size
 
         # init raw scores (`GBMClassifier.scala:275-288`); num_classes is
@@ -667,12 +695,14 @@ class GBMClassifier(_GBMParams):
         if mesh is not None:
             ctx, X = self._shard_fit_rows(mesh, base, ctx, X, n_pad)
             y_enc = jax.device_put(
-                _pad_rows(y_enc, n_pad), NamedSharding(mesh, P("data", None))
+                _pad_rows(y_enc, n_pad), NamedSharding(mesh, P(ax, None))
             )
-            w = jax.device_put(_pad_rows(w, n_pad), NamedSharding(mesh, P("data")))
+            w = jax.device_put(_pad_rows(w, n_pad), NamedSharding(mesh, P(ax)))
         pred = jnp.broadcast_to(init_raw[None, :], (n_pad, dim)).astype(jnp.float32)
         if mesh is not None:
-            pred = jax.device_put(pred, NamedSharding(mesh, P("data", None)))
+            pred = jax.device_put(
+                pred, NamedSharding(mesh, P(_mesh_row_spec(mesh), None))
+            )
 
         def build_round_step():
             k_local = dim // member_size
@@ -711,12 +741,23 @@ class GBMClassifier(_GBMParams):
                         return jnp.sum(
                             bag_w * loss.loss(y_enc, pred + a[None, :] * directions)
                         )
+
+                    # one-pass closed-form grad/hessian (ops/losses.py)
+                    # instead of dim forward passes of jax.hessian per
+                    # Newton iteration — the dominant round cost at K=26
+                    if loss.has_hessian:
+                        gh = lambda a: loss.linesearch_grad_hess(
+                            y_enc, pred + a[None, :] * directions, directions, bag_w
+                        )
+                    else:
+                        gh = None
                     alpha_opt = projected_newton_box(
                         phi,
                         jnp.ones((dim,), jnp.float32),
                         max_iter=min(max_iter, 25),
                         tol=tol,
                         axis_name=ax,
+                        grad_hess=gh,
                     )
                 else:
                     alpha_opt = jnp.ones((dim,), jnp.float32)
@@ -731,19 +772,19 @@ class GBMClassifier(_GBMParams):
                     round_core,
                     mesh=mesh,
                     in_specs=(
-                        base.ctx_specs(ctx, "data"),
-                        P("data", None),  # X
-                        P("data", None),  # y_enc
-                        P("data"),  # w
-                        P("data"),  # bag_w
+                        base.ctx_specs(ctx, ax),
+                        P(ax, None),  # X
+                        P(ax, None),  # y_enc
+                        P(ax),  # w
+                        P(ax),  # bag_w
                         P(),  # key
                         P(),  # mask
-                        P("data", None),  # pred
+                        P(ax, None),  # pred
                     ),
                     out_specs=(
                         P("member") if member_size > 1 else P(),
                         P(),
-                        P("data", None),
+                        P(ax, None),
                     ),
                     check_vma=False,
                 )
@@ -801,7 +842,9 @@ class GBMClassifier(_GBMParams):
             i, v, best = last_round + 1, int(st["v"]), float(st["best"])
             pred = jnp.asarray(st["pred"])
             if mesh is not None:
-                pred = jax.device_put(pred, NamedSharding(mesh, P("data", None)))
+                pred = jax.device_put(
+                    pred, NamedSharding(mesh, P(_mesh_row_spec(mesh), None))
+                )
             pred_val = st.get("pred_val")
             members = list(st["members"])
             weights = [jnp.asarray(x) for x in st["weights"]]

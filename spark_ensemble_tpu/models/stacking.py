@@ -53,8 +53,38 @@ class _StackingParams(Estimator):
 
     base_learners = Param(None, is_estimator=True)
     stacker = Param(None, is_estimator=True)
-    parallelism = Param(1, doc="API parity; fits are dispatched back-to-back")
+    parallelism = Param(
+        1,
+        doc="max concurrent base-learner fits — the analogue of the "
+        "reference's driver thread-pool Futures "
+        "(`StackingClassifier.scala:174-186`); heterogeneous members "
+        "trace/compile in parallel threads and XLA overlaps their "
+        "device programs",
+    )
     seed = Param(0)
+
+    def _fit_bases(self, bases, X, y, w, sample_weight, num_classes=None):
+        """Fit the heterogeneous base learners, concurrently when
+        ``parallelism > 1`` (order-preserving)."""
+
+        def fit_one(base):
+            sw = w if base.supports_weight else None
+            if not base.supports_weight and sample_weight is not None:
+                logger.warning(
+                    "base learner %s does not support weights; ignoring",
+                    type(base).__name__,
+                )
+            if num_classes is not None and base.is_classifier:
+                return base.fit(X, y, sample_weight=sw, num_classes=num_classes)
+            return base.fit(X, y, sample_weight=sw)
+
+        par = int(self.parallelism or 1)
+        if par > 1 and len(bases) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(par, len(bases))) as ex:
+                return list(ex.map(fit_one, bases))
+        return [fit_one(b) for b in bases]
 
 
 class StackingRegressor(_StackingParams):
@@ -70,15 +100,7 @@ class StackingRegressor(_StackingParams):
     def fit(self, X, y, sample_weight=None) -> "StackingRegressionModel":
         X, y = as_f32(X), as_f32(y)
         w = resolve_weights(y, sample_weight)
-        models = []
-        for i, base in enumerate(self._bases()):
-            sw = w if base.supports_weight else None
-            if not base.supports_weight and sample_weight is not None:
-                logger.warning(
-                    "base learner %s does not support weights; ignoring",
-                    type(base).__name__,
-                )
-            models.append(base.fit(X, y, sample_weight=sw))
+        models = self._fit_bases(self._bases(), X, y, w, sample_weight)
         meta = jnp.stack([m.predict(X) for m in models], axis=1)  # [n, num_bases]
         stack_model = self._stacker().fit(meta, y, sample_weight=w)
         return StackingRegressionModel(
@@ -133,20 +155,9 @@ class StackingClassifier(_StackingParams):
         X, y = as_f32(X), as_f32(y)
         w = resolve_weights(y, sample_weight)
         num_classes = infer_num_classes(y, num_classes)
-        models = []
-        for base in self._bases():
-            sw = w if base.supports_weight else None
-            if not base.supports_weight and sample_weight is not None:
-                logger.warning(
-                    "base learner %s does not support weights; ignoring",
-                    type(base).__name__,
-                )
-            if base.is_classifier:
-                models.append(
-                    base.fit(X, y, sample_weight=sw, num_classes=num_classes)
-                )
-            else:
-                models.append(base.fit(X, y, sample_weight=sw))
+        models = self._fit_bases(
+            self._bases(), X, y, w, sample_weight, num_classes=num_classes
+        )
         meta = self._meta_features(models, X)
         stacker = self._stacker()
         stack_model = (

@@ -118,6 +118,7 @@ def projected_newton_box(
     tol: float = 1e-6,
     num_backtracks: int = 15,
     axis_name=None,
+    grad_hess: Callable = None,
 ) -> jax.Array:
     """Minimize ``f`` over the box ``x >= lower`` by projected Newton.
 
@@ -141,35 +142,60 @@ def projected_newton_box(
     red = lambda v: preduce(v, axis_name)
 
     fval = lambda x: red(f(x))
-    grad_f = lambda x: red(jax.grad(f)(x))
-    hess_f = lambda x: red(jax.hessian(f)(x))
-    ts = 0.5 ** jnp.arange(num_backtracks, dtype=jnp.float32)
+    if grad_hess is None:
+        # autodiff fallback: jax.hessian costs k forward passes over the
+        # objective per iteration; losses supply a one-pass closed form
+        # via `grad_hess` (ops/losses.py linesearch_grad_hess)
+        grad_hess = lambda x: (jax.grad(f)(x), jax.hessian(f)(x))
 
     def proj(x):
         return jnp.maximum(x, lower)
 
-    def body(carry, _):
-        x, fx = carry
-        g = grad_f(x)
-        H = hess_f(x)
+    # while_loops with data-uniform conditions (all operands are psum-ed, so
+    # every shard agrees): Newton exits when the projected gradient is flat
+    # (typically ~5 iterations instead of the max), and backtracking stops at
+    # the FIRST accepted candidate (same first-success semantics as sweeping
+    # t in {1, 1/2, 1/4, ...}; usually 1 objective eval per iteration)
+    def cond(s):
+        x, fx, it, done = s
+        return (~done) & (it < max_iter)
+
+    def body(s):
+        x, fx, it, _ = s
+        g, H = grad_hess(x)
+        g, H = red(g), red(H)
         active = (x <= lower + 1e-12) & (g > 0)
         free = ~active
         fm = free.astype(x.dtype)
+        converged = jnp.max(jnp.abs(g * fm)) <= tol * (1.0 + jnp.abs(fx))
         Hm = H * fm[:, None] * fm[None, :] + jnp.diag(
             jnp.where(free, 1e-6, 1.0)
         )
         step = -jax.scipy.linalg.solve(Hm, g * fm, assume_a="pos") * fm
 
-        cand = jax.vmap(lambda t: proj(x + t * step))(ts)
-        fc = jax.vmap(fval)(cand)
-        ok = fc < fx  # sufficient decrease
-        idx = jnp.argmax(ok)
-        any_ok = jnp.any(ok)
-        x_new = jnp.where(any_ok, cand[idx], x)
-        f_new = jnp.where(any_ok, fc[idx], fx)
-        return (x_new, f_new), None
+        def bt_cond(b):
+            t, fc, j = b
+            # ~(fc < fx), NOT fc >= fx: a NaN objective (overflowing loss at
+            # an aggressive full Newton step times 0-weight padding rows)
+            # must count as "not accepted" and keep halving
+            return ~(fc < fx) & (j < num_backtracks)
 
-    (x, _), _ = jax.lax.scan(
-        body, (proj(x0), fval(proj(x0))), None, length=max_iter
+        def bt_body(b):
+            t, fc, j = b
+            t2 = 0.5 * t
+            return (t2, fval(proj(x + t2 * step)), j + 1)
+
+        t, fc, _ = jax.lax.while_loop(
+            bt_cond, bt_body, (1.0, fval(proj(x + step)), 1)
+        )
+        accepted = fc < fx
+        ok = accepted & ~converged
+        x_new = jnp.where(ok, proj(x + t * step), x)
+        f_new = jnp.where(ok, fc, fx)
+        done = converged | ~accepted  # converged, or no decrease found
+        return (x_new, f_new, it + 1, done)
+
+    x, _, _, _ = jax.lax.while_loop(
+        cond, body, (proj(x0), fval(proj(x0)), 0, False)
     )
     return x

@@ -1,3 +1,7 @@
-from spark_ensemble_tpu.parallel.mesh import create_mesh, data_member_mesh
+from spark_ensemble_tpu.parallel.mesh import (
+    create_mesh,
+    data_member_mesh,
+    hybrid_data_member_mesh,
+)
 
-__all__ = ["create_mesh", "data_member_mesh"]
+__all__ = ["create_mesh", "data_member_mesh", "hybrid_data_member_mesh"]

@@ -50,6 +50,52 @@ def data_member_mesh(
     )
 
 
+def hybrid_data_member_mesh(
+    dcn_data: int = 1, member: int = 1, devices: Optional[Sequence] = None
+) -> Mesh:
+    """Multi-slice pod mesh: ``("dcn_data", "data", "member")``.
+
+    The outer ``dcn_data`` axis spans slices over DCN; ``data`` and
+    ``member`` stay within a slice on ICI.  Row reductions then decompose
+    into a fast ICI psum per slice plus one small cross-slice psum over
+    ``dcn_data`` — histogram/hessian/objective sums are gradient-like
+    reductions that tolerate DCN latency (module docstring).  Estimator
+    fits accept this mesh directly: pass shardings with rows split over
+    ``("dcn_data", "data")``.
+
+    On multi-slice TPU hardware the device order comes from
+    ``mesh_utils.create_hybrid_device_mesh`` (DCN-aware placement); on
+    single-slice or CPU devices it falls back to a plain reshape, which is
+    functionally identical (collectives still compile and run — placement
+    is a performance detail the real pod supplies).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % (dcn_data * member) != 0:
+        raise ValueError(
+            f"dcn_data={dcn_data} * member={member} must divide {n} devices"
+        )
+    ici_data = n // (dcn_data * member)
+    shape = (dcn_data, ici_data, member)
+    if getattr(devices[0], "slice_index", None) is None:
+        # single-slice / CPU devices: no slice topology to respect; a plain
+        # reshape is functionally identical (placement is a perf detail the
+        # real pod supplies)
+        arr = np.array(devices).reshape(shape)
+    else:
+        # real multi-slice topology: DCN-aware placement; configuration
+        # errors (e.g. dcn_data != slice count) must propagate, not be
+        # silently reshaped across slice boundaries
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_hybrid_device_mesh(
+            (1, ici_data, member),
+            (dcn_data, 1, 1),
+            devices=devices,
+        )
+    return Mesh(arr, ("dcn_data", "data", "member"))
+
+
 def data_sharding(mesh: Mesh, *batch_axis_first: int) -> NamedSharding:
     """Rows-on-data sharding for an array whose axis 0 is the row axis."""
     return NamedSharding(mesh, PartitionSpec("data"))

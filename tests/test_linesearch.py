@@ -43,3 +43,74 @@ def test_projected_newton_active_bound():
 
 
 import jax  # noqa: E402  (used by test_brent_nonconvex_finds_low_value)
+
+
+def test_closed_form_linesearch_grad_hess_matches_autodiff():
+    """loss.linesearch_grad_hess == jax.grad/jax.hessian of the step-size
+    objective, for every hessian-bearing loss; the Newton solve must land
+    on the same optimum either way."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_ensemble_tpu.ops import losses as L
+    from spark_ensemble_tpu.ops.linesearch import projected_newton_box
+
+    rng = np.random.RandomState(0)
+    n = 300
+    for loss in (L.LogLoss(5), L.ExponentialLoss(), L.BernoulliLoss(),
+                 L.SquaredLoss(), L.LogCoshLoss()):
+        k = loss.dim
+        if loss.name in ("exponential", "bernoulli"):
+            y = (rng.rand(n) > 0.5).astype(np.float32)
+        elif loss.name == "logloss":
+            y = rng.randint(0, 5, n).astype(np.float32)
+        else:
+            y = rng.randn(n).astype(np.float32)
+        y_enc = loss.encode_label(jnp.asarray(y))
+        pred = jnp.asarray(rng.randn(n, k).astype(np.float32))
+        dirs = jnp.asarray(rng.randn(n, k).astype(np.float32))
+        bw = jnp.asarray(rng.poisson(1.0, n).astype(np.float32))
+
+        def phi(a):
+            return jnp.sum(bw * loss.loss(y_enc, pred + a[None, :] * dirs))
+
+        a0 = jnp.asarray(rng.rand(k).astype(np.float32))
+        g_auto = jax.grad(phi)(a0)
+        h_auto = jax.hessian(phi)(a0)
+        g_cf, h_cf = loss.linesearch_grad_hess(
+            y_enc, pred + a0[None, :] * dirs, dirs, bw
+        )
+        assert np.allclose(np.asarray(g_cf), np.asarray(g_auto), rtol=2e-3, atol=2e-3), loss.name
+        assert np.allclose(np.asarray(h_cf), np.asarray(h_auto), rtol=2e-3, atol=2e-3), loss.name
+
+        x_auto = projected_newton_box(phi, jnp.ones(k), max_iter=15)
+        gh = lambda a: loss.linesearch_grad_hess(
+            y_enc, pred + a[None, :] * dirs, dirs, bw
+        )
+        x_cf = projected_newton_box(phi, jnp.ones(k), max_iter=15, grad_hess=gh)
+        assert np.allclose(np.asarray(x_auto), np.asarray(x_cf), atol=5e-3), loss.name
+
+
+def test_backtracking_recovers_from_nan_objective():
+    """A NaN objective at the full Newton step (overflowing loss) must keep
+    halving, not abort the line search (NaN fails `fc >= fx` comparisons)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_ensemble_tpu.ops.linesearch import projected_newton_box
+
+    def phi(a):
+        v = jnp.sum((a - 0.3) ** 2)
+        return jnp.where(jnp.max(a) > 2.0, jnp.nan, v)
+
+    # tiny reported hessian forces a huge overshooting Newton step into the
+    # NaN region at t=1; backtracking must recover a finite decrease
+    gh = lambda a: (2.0 * (a - 0.3), 0.005 * jnp.eye(2))
+    x = np.asarray(
+        projected_newton_box(
+            phi, jnp.full((2,), 0.1), max_iter=10, grad_hess=gh
+        )
+    )
+    assert np.all(np.isfinite(x))
+    assert np.all(np.abs(x - 0.3) < 0.1), x

@@ -95,3 +95,26 @@ def test_stacking_heterogeneous_regression_bases(cpusmall):
     ).fit(Xtr, ytr)
     lin_err = rmse(se.LinearRegression().fit(Xtr, ytr).predict(Xte), yte)
     assert rmse(stack.predict(Xte), yte) <= lin_err * 1.05
+
+
+def test_parallel_fits_match_sequential():
+    """parallelism > 1 (thread-pool member fits, the reference's driver
+    Futures) must produce identical models to sequential fitting."""
+    rng = np.random.RandomState(4)
+    X = rng.randn(400, 6).astype(np.float32)
+    y = rng.randint(0, 3, 400).astype(np.float32)
+    bases = lambda: [
+        se.DecisionTreeClassifier(max_depth=4),
+        se.LogisticRegression(max_iter=20),
+        se.GaussianNaiveBayes(),
+    ]
+    seq = se.StackingClassifier(
+        base_learners=bases(), stack_method="proba", parallelism=1
+    ).fit(X, y)
+    par = se.StackingClassifier(
+        base_learners=bases(), stack_method="proba", parallelism=3
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        np.asarray(seq.predict_raw(X)), np.asarray(par.predict_raw(X)),
+        rtol=1e-5, atol=1e-5,
+    )

@@ -1,0 +1,37 @@
+// Project docs site (the analogue of the reference's docusaurus website,
+// reference website/docusaurus.config.js). Build with `npm install && npm
+// run build` inside website/; docs sources live in ../docs.
+module.exports = {
+  title: 'spark-ensemble-tpu',
+  tagline: 'Ensemble learning compiled to XLA: Bagging, Boosting, GBM, Stacking on TPU',
+  url: 'https://example.github.io',
+  baseUrl: '/spark-ensemble-tpu/',
+  favicon: 'img/favicon.ico',
+  organizationName: 'spark-ensemble-tpu',
+  projectName: 'spark-ensemble-tpu',
+  themeConfig: {
+    navbar: {
+      title: 'spark-ensemble-tpu',
+      items: [
+        { to: 'docs/overview', label: 'Documentation', position: 'right' },
+      ],
+    },
+    colorMode: {
+      disableSwitch: true,
+    },
+  },
+  presets: [
+    [
+      '@docusaurus/preset-classic',
+      {
+        docs: {
+          path: '../docs',
+          sidebarPath: require.resolve('./sidebars.js'),
+        },
+        theme: {
+          customCss: require.resolve('./src/css/custom.css'),
+        },
+      },
+    ],
+  ],
+};

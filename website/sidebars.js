@@ -1,0 +1,12 @@
+module.exports = {
+  docs: {
+    Documentation: [
+      'overview',
+      'bagging',
+      'boosting',
+      'gbm',
+      'stacking',
+      'example',
+    ],
+  },
+};

@@ -1,0 +1,21 @@
+import React from 'react';
+import Layout from '@theme/Layout';
+import Link from '@docusaurus/Link';
+
+export default function Home() {
+  return (
+    <Layout title="spark-ensemble-tpu">
+      <main style={{padding: '4rem', textAlign: 'center'}}>
+        <h1>spark-ensemble-tpu</h1>
+        <p>
+          Ensemble learning compiled to XLA: Bagging, Boosting, GBM and
+          Stacking meta-estimators over pluggable base learners, sharded
+          across TPU meshes.
+        </p>
+        <Link className="button button--primary" to="docs/overview">
+          Get started
+        </Link>
+      </main>
+    </Layout>
+  );
+}
