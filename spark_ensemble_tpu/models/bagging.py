@@ -104,6 +104,28 @@ class _BaggingParams(Estimator):
         )
 
 
+def _build_fit_all(base: BaseLearner, sharded: bool):
+    """All-member fit program.  Single-device: the fused multi-member path
+    (``fit_many_from_ctx`` — trees fold the member axis into one histogram
+    matmul per level).  Mesh-sharded members: the vmapped per-member program,
+    which GSPMD partitions along the member axis across devices."""
+    if sharded:
+        return jax.jit(
+            lambda ctx, y, fit_w, masks, keys: jax.vmap(
+                lambda fw, m, k: base.fit_from_ctx(ctx, y, fw, m, k)
+            )(fit_w, masks, keys)
+        )
+    return jax.jit(
+        lambda ctx, y, fit_w, masks, keys: base.fit_many_from_ctx(
+            ctx,
+            jnp.broadcast_to(y[:, None], (y.shape[0], fit_w.shape[0])),
+            fit_w.T,
+            masks,
+            keys,
+        )
+    )
+
+
 class BaggingRegressor(_BaggingParams):
     is_classifier = False
 
@@ -126,12 +148,8 @@ class BaggingRegressor(_BaggingParams):
                 mesh, ctx, y, fit_w, masks, keys
             )
         fit_all = cached_program(
-            ("bagging_fit", base.config_key()),
-            lambda: jax.jit(
-                lambda ctx, y, fit_w, masks, keys: jax.vmap(
-                    lambda fw, m, k: base.fit_from_ctx(ctx, y, fw, m, k)
-                )(fit_w, masks, keys)
-            ),
+            ("bagging_fit", base.config_key(), mesh is not None),
+            lambda: _build_fit_all(base, sharded=mesh is not None),
         )
         members = fit_all(ctx, y, fit_w, masks, keys)
         members = jax.tree_util.tree_map(
@@ -184,12 +202,8 @@ class BaggingClassifier(_BaggingParams):
                 mesh, ctx, y, fit_w, masks, keys
             )
         fit_all = cached_program(
-            ("bagging_fit_cls", base.config_key(), num_classes),
-            lambda: jax.jit(
-                lambda ctx, y, fit_w, masks, keys: jax.vmap(
-                    lambda fw, m, k: base.fit_from_ctx(ctx, y, fw, m, k)
-                )(fit_w, masks, keys)
-            ),
+            ("bagging_fit_cls", base.config_key(), num_classes, mesh is not None),
+            lambda: _build_fit_all(base, sharded=mesh is not None),
         )
         members = fit_all(ctx, y, fit_w, masks, keys)
         members = jax.tree_util.tree_map(

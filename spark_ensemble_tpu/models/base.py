@@ -234,6 +234,7 @@ class CheckpointableParams(Params):
         "checkpoint_dir",
         "profile_dir",
         "feature_names",
+        "scan_chunk",
     )
 
     def _resume_identity(self):
@@ -307,6 +308,42 @@ class BaseLearner(Estimator):
         ``treeAggregate`` (`GBMClassifier.scala:344-355`).
         """
         raise NotImplementedError
+
+    def fit_many_from_ctx(
+        self,
+        ctx: Any,
+        ys: jax.Array,  # [n, M] per-member target columns
+        ws: jax.Array,  # [n, M] per-member weights
+        feature_masks: Optional[jax.Array],  # [M, d] | [d] | None
+        keys: jax.Array,  # [M, 2] | [2]
+        axis_name: Optional[str] = None,
+    ) -> Any:
+        """Fit M members in one program -> stacked params (leading M axis).
+
+        Default: ``vmap`` of ``fit_from_ctx`` — one XLA program for all
+        members, the baseline replacement for the reference's driver-side
+        ``Future`` pools.  Learners whose member fits share large read-only
+        operands override this to FUSE members into single kernels instead
+        (trees fold the member axis into the histogram matmul's M dim,
+        ``ops.tree.fit_forest``) — vmap alone re-streams the shared operand
+        per member and leaves the op bandwidth-bound.
+        """
+        M = ys.shape[1]
+        mask_axis = 0
+        if feature_masks is None:
+            mask_axis = None
+        elif feature_masks.ndim == 1:
+            feature_masks = jnp.broadcast_to(
+                feature_masks[None, :], (M,) + feature_masks.shape
+            )
+        if keys.ndim == 1:
+            keys = jnp.broadcast_to(keys[None, :], (M,) + keys.shape)
+        return jax.vmap(
+            lambda y, w, m, k: self.fit_from_ctx(
+                ctx, y, w, m, k, axis_name=axis_name
+            ),
+            in_axes=(1, 1, mask_axis, 0),
+        )(ys, ws, feature_masks, keys)
 
     def ctx_specs(self, ctx: Any, data_axis: str):
         """``shard_map`` PartitionSpecs for the fit ctx under row sharding:

@@ -155,6 +155,17 @@ def slice_pytree(tree: Any, n: int):
     return jax.tree_util.tree_map(lambda x: x[:n], tree)
 
 
+def concat_pytrees(chunks: List[Any]):
+    """Concatenate round-stacked pytrees along the leading (round) axis."""
+    if len(chunks) == 1:
+        return chunks[0]
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs, axis=0), *chunks
+    )
+
+
+
+
 class _GBMParams(CheckpointableParams, Estimator):
     """Shared GBM params (reference `GBMParams.scala:29-137` defaults)."""
 
@@ -172,6 +183,14 @@ class _GBMParams(CheckpointableParams, Estimator):
     validation_tol = Param(0.01, gt_eq(0.0))
     seed = Param(0)
     aggregation_depth = Param(2, gt_eq(1), doc="API parity; reductions are psum")
+    scan_chunk = Param(
+        16,
+        gt_eq(1),
+        doc="rounds fused into one lax.scan-ed XLA program on the "
+        "single-program (mesh=None) path; amortizes per-dispatch overhead "
+        "without changing round math (validation early-stop still applies "
+        "per round, overshooting at most one chunk of compute)",
+    )
     checkpoint_interval = Param(10, gt_eq(1))
     checkpoint_dir = Param(
         None,
@@ -217,6 +236,107 @@ class _GBMParams(CheckpointableParams, Estimator):
                 )
             ),
         )
+
+    def _make_bag_many_fn(self, n: int, n_pad: int):
+        """Vmapped bag draws for a chunk of rounds: [c, 2] keys -> [c, n_pad]
+        weights, bit-identical per round to ``_make_bag_fn``."""
+        repl, sub_ratio = bool(self.replacement), float(self.subsample_ratio)
+        return cached_program(
+            ("gbm_bag_many", n, n_pad, repl, sub_ratio),
+            lambda: jax.jit(
+                jax.vmap(
+                    lambda key: _pad_rows(
+                        bootstrap_weights(key, n, repl, sub_ratio), n_pad
+                    )
+                )
+            ),
+        )
+
+    @staticmethod
+    def _resume_chunks(st):
+        """Checkpointed members/weights -> round-stacked chunk lists.
+        Handles both the stacked layout (current) and the legacy
+        per-round-list layout."""
+        st_members, st_weights = st["members"], st["weights"]
+        if isinstance(st_members, list):
+            return (
+                [
+                    jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], m)
+                    for m in st_members
+                ],
+                [jnp.asarray(x)[None] for x in st_weights],
+            )
+        return (
+            [jax.tree_util.tree_map(jnp.asarray, st_members)],
+            [jnp.asarray(st_weights)],
+        )
+
+    def _drive_rounds(
+        self,
+        mesh,
+        ckpt,
+        members_chunks: List[Any],
+        weights_chunks: List[Any],
+        run_chunk,  # (sl: slice) -> (params [c,...], weights [c,...], errs|None)
+        run_round,  # (i: int) -> (params, weight, err|None)   [mesh path]
+        save_state,  # (round_idx, v, best) -> None  (must self-gate)
+        label: str,
+        i: int,
+        v: int,
+        best: float,
+    ):
+        """The shared round-loop driver: scan-chunked dispatch on the
+        single-program path, per-round dispatch under a mesh; patience
+        bookkeeping, mid-chunk stop accounting, and periodic state saves are
+        identical for both GBM flavors.  ``run_chunk``/``run_round`` own the
+        prediction-state updates (via closure); extra members computed past a
+        mid-chunk validation stop are trimmed by the caller's final
+        ``keep = i - v`` slice."""
+        chunk = max(int(self.scan_chunk), 1)
+        while i < self.num_base_learners and v < self.num_rounds:
+            if mesh is None:
+                c = min(chunk, self.num_base_learners - i)
+                if ckpt.enabled:
+                    # end the chunk exactly on the next save boundary: keeps
+                    # periodic saves firing at any resume offset, including a
+                    # resume under a CHANGED checkpoint_interval
+                    c = min(c, ckpt.rounds_until_save(i))
+                params_c, weights_c, errs = run_chunk(slice(i, i + c))
+                members_chunks.append(params_c)
+                weights_chunks.append(weights_c)
+                stopped = False
+                if errs is not None:
+                    for j, err in enumerate(np.asarray(errs)):
+                        best, v = self._patience_step(
+                            best, float(err), v, self.validation_tol
+                        )
+                        logger.info(
+                            "%s round %d: val_loss=%.6f patience=%d",
+                            label, i + j, float(err), v,
+                        )
+                        if v >= self.num_rounds:
+                            i += j + 1
+                            stopped = True
+                            break
+                if not stopped:
+                    i += c
+                    save_state(i - 1, v, best)
+            else:
+                params, weight, err = run_round(i)
+                if err is not None:
+                    best, v = self._patience_step(
+                        best, err, v, self.validation_tol
+                    )
+                    logger.info(
+                        "%s round %d: val_loss=%.6f patience=%d", label, i, err, v
+                    )
+                members_chunks.append(
+                    jax.tree_util.tree_map(lambda x: x[None], params)
+                )
+                weights_chunks.append(weight[None])
+                save_state(i, v, best)
+                i += 1
+        return i, v, best
 
     @staticmethod
     def _shard_fit_rows(mesh: Mesh, base: BaseLearner, ctx, X, n_pad: int):
@@ -377,9 +497,11 @@ class GBMRegressor(_GBMParams):
                 loss_name, alpha=alpha_q, quantile=alpha_q
             )
 
+        with_validation = X_val is not None
+
         # all data flows through arguments so the jitted programs are
         # reusable across fits with the same config (no per-fit retrace)
-        def build_round_step():
+        def make_round_core():
             def round_core(ctx, X, bag_w, key, mask, pred, delta, y, w):
                 loss = make_loss(delta)
                 y_enc = loss.encode_label(y)
@@ -406,11 +528,12 @@ class GBMRegressor(_GBMParams):
                 new_pred = pred + weight * direction
                 return params, weight, new_pred
 
-            if mesh is None:
-                return jax.jit(round_core)
+            return round_core
+
+        def build_round_step():
             return jax.jit(
                 shard_map(
-                    round_core,
+                    make_round_core(),
                     mesh=mesh,
                     in_specs=(
                         base.ctx_specs(ctx, ax),
@@ -428,25 +551,66 @@ class GBMRegressor(_GBMParams):
                 )
             )
 
-        round_step = cached_program(
-            (
-                "gbm_reg_round",
-                loss_name,
-                alpha_q,
-                updates,
-                optimized,
-                lr,
-                sub_ratio,
-                repl,
-                tol,
-                max_iter,
-                base_key,
-                mesh,
-            ),
-            build_round_step,
-        )
+        def build_chunk_step():
+            """lax.scan of round_core over a chunk of rounds (one dispatch
+            per chunk; huber's adaptive delta and the validation loss are
+            computed in-program, in the same per-round order as the host
+            loop)."""
+            round_core = make_round_core()
 
-        bag_fn = self._make_bag_fn(n, n_pad)
+            def chunk(ctx, X, y, w, valid_w, pred, pred_val, delta,
+                      X_val_a, y_val_a, bag_ws, keys, masks):
+                def body(carry, xs):
+                    pred, pred_val, delta = carry
+                    bag_w, key, mask = xs
+                    if huber:
+                        delta = weighted_quantile(
+                            jnp.abs(y - pred), alpha_q, weights=valid_w
+                        )
+                    params, weight, new_pred = round_core(
+                        ctx, X, bag_w, key, mask, pred, delta, y, w
+                    )
+                    if with_validation:
+                        dir_val = base.predict_fn(params, X_val_a)
+                        new_pred_val = pred_val + weight * dir_val
+                        l = make_loss(delta)
+                        err = jnp.mean(
+                            l.loss(l.encode_label(y_val_a), new_pred_val[:, None])
+                        )
+                    else:
+                        new_pred_val = pred_val
+                        err = jnp.float32(0)
+                    return (new_pred, new_pred_val, delta), (params, weight, err)
+
+                (pred, pred_val, delta), (params_all, weights_all, errs) = (
+                    jax.lax.scan(body, (pred, pred_val, delta), (bag_ws, keys, masks))
+                )
+                return params_all, weights_all, errs, pred, pred_val, delta
+
+            return jax.jit(chunk)
+
+        round_key = (
+            "gbm_reg_round",
+            loss_name,
+            alpha_q,
+            updates,
+            optimized,
+            lr,
+            sub_ratio,
+            repl,
+            tol,
+            max_iter,
+            base_key,
+            mesh,
+        )
+        if mesh is not None:
+            round_step = cached_program(round_key, build_round_step)
+            bag_fn = self._make_bag_fn(n, n_pad)
+        else:
+            chunk_step = cached_program(
+                round_key + ("chunk", huber, with_validation), build_chunk_step
+            )
+            bag_many = self._make_bag_many_fn(n, n_pad)
 
         eval_loss = cached_program(
             ("gbm_reg_eval", loss_name, alpha_q),
@@ -473,14 +637,17 @@ class GBMRegressor(_GBMParams):
             lambda: jax.jit(base.predict_fn),
         )
 
-        with_validation = X_val is not None
         best = 0.0
         pred_val = None
+        val_dummy = jnp.zeros((0,), jnp.float32)
         if with_validation:
+            X_val = jnp.asarray(X_val)
+            y_val = jnp.asarray(y_val)
             pred_val = init_model.predict(X_val)
             best = float(eval_loss(pred_val, delta, y_val))
 
-        members, weights = [], []
+        members_chunks: List[Any] = []
+        weights_chunks: List[Any] = []
         i, v = 0, 0
 
         # n_pad is part of the identity: checkpointed `pred` is padded to
@@ -498,47 +665,75 @@ class GBMRegressor(_GBMParams):
                     pred, NamedSharding(mesh, P(_mesh_row_spec(mesh)))
                 )
             pred_val = st.get("pred_val")
-            members = list(st["members"])
-            weights = [jnp.asarray(x) for x in st["weights"]]
+            if pred_val is not None:
+                pred_val = jnp.asarray(pred_val)
+            members_chunks, weights_chunks = self._resume_chunks(st)
             delta = jnp.asarray(st["delta"])
             logger.info("GBMRegressor resuming from round %d", i)
 
-        while i < self.num_base_learners and v < self.num_rounds:
-            if huber:
-                delta = huber_delta(pred, y, valid_w)
-            params, weight, pred = round_step(
-                ctx, X, bag_fn(bag_keys[i]), bag_keys[i], masks[i], pred, delta, y, w
-            )
-            members.append(params)
-            weights.append(weight)
-            if with_validation:
-                direction_val = predict_member(params, X_val)
-                pred_val = pred_val + weight * direction_val
-                err = float(eval_loss(pred_val, delta, y_val))
-                best, v = self._patience_step(best, err, v, self.validation_tol)
-                logger.info("GBMRegressor round %d: val_loss=%.6f patience=%d", i, err, v)
-            ckpt.maybe_save(
-                i,
+        def save_state(round_idx, v, best):
+            # gate BEFORE building the state: the full-history concat below
+            # must not run every round when checkpointing is off
+            if not ckpt.should_save(round_idx):
+                return
+            ckpt.save(
+                round_idx,
                 {
                     "v": v,
                     "best": best,
                     "pred": pred,
                     "pred_val": pred_val,
-                    "members": members,
-                    "weights": list(weights),
+                    "members": concat_pytrees(members_chunks),
+                    "weights": concat_pytrees(weights_chunks),
                     "delta": delta,
                 },
             )
-            i += 1
+
+        def run_chunk(sl):
+            nonlocal pred, pred_val, delta
+            params_c, weights_c, errs, pred, pred_val_new, delta = chunk_step(
+                ctx, X, y, w, valid_w, pred,
+                pred_val if with_validation else val_dummy,
+                delta,
+                X_val if with_validation else val_dummy,
+                y_val if with_validation else val_dummy,
+                bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
+            )
+            if with_validation:
+                pred_val = pred_val_new
+            return params_c, weights_c, errs if with_validation else None
+
+        def run_round(i):
+            nonlocal pred, pred_val, delta
+            if huber:
+                delta = huber_delta(pred, y, valid_w)
+            params, weight, pred = round_step(
+                ctx, X, bag_fn(bag_keys[i]), bag_keys[i], masks[i], pred,
+                delta, y, w,
+            )
+            err = None
+            if with_validation:
+                direction_val = predict_member(params, X_val)
+                pred_val = pred_val + weight * direction_val
+                err = float(eval_loss(pred_val, delta, y_val))
+            return params, weight, err
+
+        i, v, best = self._drive_rounds(
+            mesh, ckpt, members_chunks, weights_chunks,
+            run_chunk, run_round, save_state, "GBMRegressor", i, v, best,
+        )
         ckpt.delete()
 
         keep = i - v
         instr.log_outcome(rounds=i, kept_members=keep)
-        model_params = stack_pytrees(members[:keep]) if keep > 0 else None
+        all_members = concat_pytrees(members_chunks) if members_chunks else None
+        all_weights = (
+            jnp.concatenate(weights_chunks) if weights_chunks else None
+        )
         return GBMRegressionModel(
             params={
-                "members": model_params,
-                "weights": jnp.stack(weights[:keep]) if keep > 0 else jnp.zeros((0,)),
+                "members": slice_pytree(all_members, keep) if keep > 0 else None,
+                "weights": all_weights[:keep] if keep > 0 else jnp.zeros((0,)),
                 "masks": masks[:keep],
                 "init": init_model.params,
             },
@@ -704,7 +899,11 @@ class GBMClassifier(_GBMParams):
                 pred, NamedSharding(mesh, P(_mesh_row_spec(mesh), None))
             )
 
-        def build_round_step():
+        with_validation = X_val is not None
+        if with_validation:
+            y_enc_val = loss.encode_label(y_val)
+
+        def make_round_core():
             k_local = dim // member_size
 
             def round_core(ctx, X, y_enc, w, bag_w, key, mask, pred):
@@ -723,11 +922,12 @@ class GBMClassifier(_GBMParams):
                     )
                 else:
                     labels_blk, fitw_blk = labels, fit_w
-                # class-dim vmap replaces the reference's per-dim Futures
-                fit_j = lambda lab, fw: base.fit_from_ctx(
-                    ctx, lab, fw, mask, key, axis_name=ax
+                # one fused multi-member fit replaces the reference's
+                # per-dim Futures (trees: the class dims fold into a single
+                # histogram matmul per level — ops/tree.py fit_forest)
+                params = base.fit_many_from_ctx(
+                    ctx, labels_blk, fitw_blk, mask, key, axis_name=ax
                 )
-                params = jax.vmap(fit_j, in_axes=(1, 1))(labels_blk, fitw_blk)
                 directions = jax.vmap(lambda p: base.predict_fn(p, X))(params).T
                 if member_size > 1:
                     directions = jax.lax.all_gather(
@@ -765,8 +965,10 @@ class GBMClassifier(_GBMParams):
                 new_pred = pred + weight[None, :] * directions
                 return params, weight, new_pred
 
-            if mesh is None:
-                return jax.jit(round_core)
+            return round_core
+
+        def build_round_step():
+            round_core = make_round_core()
             return jax.jit(
                 shard_map(
                     round_core,
@@ -790,6 +992,39 @@ class GBMClassifier(_GBMParams):
                 )
             )
 
+        def build_chunk_step():
+            """lax.scan of round_core over a chunk of rounds — ONE dispatch
+            and one XLA program per chunk instead of per round (validation
+            losses computed in-program, early-stop applied on the host after
+            the chunk; round math identical to the per-round path)."""
+            round_core = make_round_core()
+
+            def chunk(ctx, X, y_enc, w, pred, pred_val, X_val_a, y_enc_val_a,
+                      bag_ws, keys, masks):
+                def body(carry, xs):
+                    pred, pred_val = carry
+                    bag_w, key, mask = xs
+                    params, weight, new_pred = round_core(
+                        ctx, X, y_enc, w, bag_w, key, mask, pred
+                    )
+                    if with_validation:
+                        dirs_val = jax.vmap(
+                            lambda p: base.predict_fn(p, X_val_a)
+                        )(params).T
+                        new_pred_val = pred_val + weight[None, :] * dirs_val
+                        err = jnp.mean(loss.loss(y_enc_val_a, new_pred_val))
+                    else:
+                        new_pred_val = pred_val
+                        err = jnp.float32(0)
+                    return (new_pred, new_pred_val), (params, weight, err)
+
+                (pred, pred_val), (params_all, weights_all, errs) = jax.lax.scan(
+                    body, (pred, pred_val), (bag_ws, keys, masks)
+                )
+                return params_all, weights_all, errs, pred, pred_val
+
+            return jax.jit(chunk)
+
         round_key = (
             "gbm_cls_round",
             loss_name,
@@ -804,9 +1039,14 @@ class GBMClassifier(_GBMParams):
             base_key,
             mesh,
         )
-        round_step = cached_program(round_key, build_round_step)
-
-        bag_fn = self._make_bag_fn(n, n_pad)
+        if mesh is not None:
+            round_step = cached_program(round_key, build_round_step)
+            bag_fn = self._make_bag_fn(n, n_pad)
+        else:
+            chunk_step = cached_program(
+                round_key + ("chunk", with_validation), build_chunk_step
+            )
+            bag_many = self._make_bag_many_fn(n, n_pad)
 
         eval_loss = cached_program(
             ("gbm_cls_eval", loss_name, num_classes),
@@ -820,17 +1060,20 @@ class GBMClassifier(_GBMParams):
             ),
         )
 
-        with_validation = X_val is not None
         best = 0.0
         pred_val = None
+        val_dummy = jnp.zeros((0,), jnp.float32)
         if with_validation:
-            y_enc_val = loss.encode_label(y_val)
+            X_val = jnp.asarray(X_val)
             pred_val = jnp.broadcast_to(
                 init_raw[None, :], (X_val.shape[0], dim)
             ).astype(jnp.float32)
             best = float(eval_loss(pred_val, y_enc_val))
 
-        members, weights = [], []
+        # member params/weights accumulate as round-stacked chunks
+        # (leading axis = rounds), concatenated once at the end
+        members_chunks: List[Any] = []
+        weights_chunks: List[Any] = []
         i, v = 0, 0
 
         # n_pad in the identity: see GBMRegressor — padded `pred` must not
@@ -846,42 +1089,69 @@ class GBMClassifier(_GBMParams):
                     pred, NamedSharding(mesh, P(_mesh_row_spec(mesh), None))
                 )
             pred_val = st.get("pred_val")
-            members = list(st["members"])
-            weights = [jnp.asarray(x) for x in st["weights"]]
+            if pred_val is not None:
+                pred_val = jnp.asarray(pred_val)
+            members_chunks, weights_chunks = self._resume_chunks(st)
             logger.info("GBMClassifier resuming from round %d", i)
 
-        while i < self.num_base_learners and v < self.num_rounds:
-            params, weight, pred = round_step(
-                ctx, X, y_enc, w, bag_fn(bag_keys[i]), bag_keys[i], masks[i], pred
-            )
-            members.append(params)
-            weights.append(weight)
-            if with_validation:
-                dirs_val = member_dirs(params, X_val)
-                pred_val = pred_val + weight[None, :] * dirs_val
-                err = float(eval_loss(pred_val, y_enc_val))
-                best, v = self._patience_step(best, err, v, self.validation_tol)
-                logger.info("GBMClassifier round %d: val_loss=%.6f patience=%d", i, err, v)
-            ckpt.maybe_save(
-                i,
+        def save_state(round_idx, v, best):
+            # gate BEFORE building the state (see GBMRegressor.save_state)
+            if not ckpt.should_save(round_idx):
+                return
+            ckpt.save(
+                round_idx,
                 {
                     "v": v,
                     "best": best,
                     "pred": pred,
                     "pred_val": pred_val,
-                    "members": members,
-                    "weights": list(weights),
+                    "members": concat_pytrees(members_chunks),
+                    "weights": concat_pytrees(weights_chunks),
                 },
             )
-            i += 1
+
+        def run_chunk(sl):
+            nonlocal pred, pred_val
+            params_c, weights_c, errs, pred, pred_val_new = chunk_step(
+                ctx, X, y_enc, w, pred,
+                pred_val if with_validation else val_dummy,
+                X_val if with_validation else val_dummy,
+                y_enc_val if with_validation else val_dummy,
+                bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
+            )
+            if with_validation:
+                pred_val = pred_val_new
+            return params_c, weights_c, errs if with_validation else None
+
+        def run_round(i):
+            nonlocal pred, pred_val
+            params, weight, pred = round_step(
+                ctx, X, y_enc, w, bag_fn(bag_keys[i]), bag_keys[i],
+                masks[i], pred,
+            )
+            err = None
+            if with_validation:
+                dirs_val = member_dirs(params, X_val)
+                pred_val = pred_val + weight[None, :] * dirs_val
+                err = float(eval_loss(pred_val, y_enc_val))
+            return params, weight, err
+
+        i, v, best = self._drive_rounds(
+            mesh, ckpt, members_chunks, weights_chunks,
+            run_chunk, run_round, save_state, "GBMClassifier", i, v, best,
+        )
         ckpt.delete()
 
         keep = i - v
         instr.log_outcome(rounds=i, kept_members=keep)
+        all_members = concat_pytrees(members_chunks) if members_chunks else None
+        all_weights = (
+            jnp.concatenate(weights_chunks) if weights_chunks else None
+        )
         return GBMClassificationModel(
             params={
-                "members": stack_pytrees(members[:keep]) if keep > 0 else None,
-                "weights": jnp.stack(weights[:keep])
+                "members": slice_pytree(all_members, keep) if keep > 0 else None,
+                "weights": all_weights[:keep]
                 if keep > 0
                 else jnp.zeros((0, dim)),
                 "masks": masks[:keep],

@@ -27,6 +27,7 @@ from spark_ensemble_tpu.models.base import (
 from spark_ensemble_tpu.ops.binning import Bins, bin_features, compute_bins
 from spark_ensemble_tpu.ops.tree import (
     Tree,
+    fit_forest,
     fit_tree,
     predict_tree,
     predict_tree_binned,
@@ -62,6 +63,26 @@ class _TreeLearner(BaseLearner):
             axis_name=axis_name,
         )
 
+    def _targets_many(self, ctx, ys) -> jax.Array:
+        """[n, M] member target columns -> [n, M, k] tree targets."""
+        raise NotImplementedError
+
+    def fit_many_from_ctx(self, ctx, ys, ws, feature_masks, keys, axis_name=None):
+        """All members in ONE fused forest fit: the member axis folds into
+        the histogram matmul's M dim (``ops.tree.fit_forest``) instead of a
+        vmap that re-streams the shared bin-one-hot per member."""
+        return fit_forest(
+            ctx["Xb"],
+            self._targets_many(ctx, ys),
+            ws,
+            ctx["thresholds"],
+            feature_masks,
+            max_depth=self.max_depth,
+            max_bins=self.max_bins,
+            min_info_gain=self.min_info_gain,
+            axis_name=axis_name,
+        )
+
     def ctx_specs(self, ctx, data_axis):
         from jax.sharding import PartitionSpec as P
 
@@ -77,6 +98,9 @@ class DecisionTreeRegressor(_TreeLearner):
 
     def _targets(self, ctx, y):
         return y[:, None]
+
+    def _targets_many(self, ctx, ys):
+        return ys[:, :, None]
 
     def predict_fn(self, params: Tree, X):
         return predict_tree(params, X)[:, 0]
@@ -97,6 +121,11 @@ class DecisionTreeClassifier(_TreeLearner):
 
     def _targets(self, ctx, y):
         return jax.nn.one_hot(y.astype(jnp.int32), static_value(ctx["num_classes"]))
+
+    def _targets_many(self, ctx, ys):
+        return jax.nn.one_hot(
+            ys.astype(jnp.int32), static_value(ctx["num_classes"])
+        )
 
     def predict_proba_fn(self, params: Tree, X):
         # leaf values are weighted one-hot means: a probability vector up to

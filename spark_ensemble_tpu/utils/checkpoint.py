@@ -50,10 +50,24 @@ class TrainingCheckpointer:
     def enabled(self) -> bool:
         return bool(self.directory)
 
+    def should_save(self, round_idx: int) -> bool:
+        """The one copy of the save-cadence rule: a save fires after round
+        ``round_idx`` iff checkpointing is on and ``round_idx + 1`` is a
+        multiple of the interval.  Callers that build expensive state dicts
+        gate on this BEFORE constructing them."""
+        return self.enabled and (round_idx + 1) % self.interval == 0
+
+    def rounds_until_save(self, i: int) -> int:
+        """Rounds from (0-based) round ``i`` to the next save boundary
+        inclusive — chunked round loops clamp their chunk length to this so
+        chunk ends land exactly on save rounds regardless of the resume
+        offset (a resume may start at a round misaligned with a *changed*
+        interval)."""
+        return self.interval - (i % self.interval)
+
     def maybe_save(self, round_idx: int, state: Dict[str, Any]) -> None:
-        if not self.enabled or (round_idx + 1) % self.interval != 0:
-            return
-        self.save(round_idx, state)
+        if self.should_save(round_idx):
+            self.save(round_idx, state)
 
     def save(self, round_idx: int, state: Dict[str, Any]) -> None:
         if not self.enabled:

@@ -120,3 +120,46 @@ def test_gbm_resume_matches_uninterrupted(tmp_path):
     b = np.asarray(resumed.predict(X[:100]))
     assert resumed.num_members == full.num_members == 6
     assert np.allclose(a, b, atol=1e-4), np.abs(a - b).max()
+
+
+def test_gbm_resume_with_changed_interval_keeps_saving(tmp_path):
+    """Regression: a resume may start at a round misaligned with a CHANGED
+    checkpoint_interval (interval is resume-neutral by design); the chunked
+    round loop must clamp chunk ends to the new save boundaries so periodic
+    saves keep firing — not silently stop until the next preemption loses
+    everything."""
+    X, y = _data()
+    ckdir = str(tmp_path / "gbm_ck2")
+
+    # preempted run: 4 rounds, interval 4 -> checkpoint at round idx 3
+    est = se.GBMRegressor(
+        num_base_learners=4, seed=3, checkpoint_dir=ckdir, checkpoint_interval=4,
+        scan_chunk=4,
+    )
+    orig_delete = TrainingCheckpointer.delete
+    TrainingCheckpointer.delete = lambda self: None
+    try:
+        est.fit(X, y)
+    finally:
+        TrainingCheckpointer.delete = orig_delete
+
+    # resume at round 4 with interval 5 (misaligned: 4 % 5 != 0); saves must
+    # fire at rounds where (idx+1) % 5 == 0 -> idx 4 and idx 9
+    saved = []
+    orig_save = TrainingCheckpointer.save
+    TrainingCheckpointer.save = lambda self, r, s: (
+        saved.append(r), orig_save(self, r, s)
+    )[1]
+    try:
+        full = se.GBMRegressor(num_base_learners=12, seed=3, scan_chunk=4).fit(X, y)
+        resumed = se.GBMRegressor(
+            num_base_learners=12, seed=3, checkpoint_dir=ckdir,
+            checkpoint_interval=5, scan_chunk=4,
+        ).fit(X, y)
+    finally:
+        TrainingCheckpointer.save = orig_save
+    assert 4 in saved and 9 in saved, saved
+    a = np.asarray(full.predict(X[:100]))
+    b = np.asarray(resumed.predict(X[:100]))
+    assert resumed.num_members == full.num_members == 12
+    assert np.allclose(a, b, atol=1e-4), np.abs(a - b).max()

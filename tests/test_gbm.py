@@ -81,6 +81,45 @@ def test_gbm_early_stop_matches_offline_sweep(cpusmall):
     assert gbm_es.num_members == expected_members
 
 
+def test_gbm_scan_chunk_invariance(cpusmall):
+    """The scan-chunked round loop must produce the same model regardless of
+    chunk size (chunk=1 is the per-round baseline): round math is identical,
+    only dispatch granularity changes.  Huber exercises the in-scan adaptive
+    delta."""
+    X, y = cpusmall
+    Xtr, ytr, _, _ = split(X, y)
+    preds = []
+    for chunk in (1, 3, 16):
+        m = se.GBMRegressor(
+            num_base_learners=5, loss="huber", updates="newton",
+            subsample_ratio=0.8, scan_chunk=chunk, seed=3,
+        ).fit(Xtr, ytr)
+        preds.append(np.asarray(m.predict(Xtr)))
+    np.testing.assert_allclose(preds[0], preds[1], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(preds[0], preds[2], rtol=1e-5, atol=1e-5)
+
+
+def test_gbm_classifier_scan_chunk_invariance_with_validation(letter):
+    """Chunked early stopping must pick the same stop round and members as
+    per-round (chunk=1) fitting, including a mid-chunk stop."""
+    X, y = letter
+    rng = np.random.RandomState(1)
+    vi = rng.rand(X.shape[0]) < 0.3
+    models = [
+        se.GBMClassifier(
+            num_base_learners=8, num_rounds=1, validation_tol=0.5,
+            learning_rate=0.5, scan_chunk=chunk, seed=2,
+        ).fit(X, y, validation_indicator=vi)
+        for chunk in (1, 5)
+    ]
+    assert models[0].num_members == models[1].num_members
+    np.testing.assert_allclose(
+        np.asarray(models[0].predict_raw(X[:200])),
+        np.asarray(models[1].predict_raw(X[:200])),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
 def test_gbm_classifier_beats_single_tree_multiclass(letter):
     X, y = letter
     Xtr, ytr, Xte, yte = split(X, y)

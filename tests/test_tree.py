@@ -207,6 +207,113 @@ def test_hist_matmul_multioutput_and_mask():
     )
 
 
+def test_fit_forest_matches_vmapped_fit_tree():
+    """The fused multi-member forest fit (one histogram matmul per level for
+    all members) must build the same trees as vmapping fit_tree — same
+    splits, same leaf values — for both histogram backends, with per-member
+    weights and feature masks."""
+    from spark_ensemble_tpu.ops.tree import fit_forest
+
+    rng = np.random.RandomState(7)
+    n, d, M = 900, 6, 5
+    X = jnp.asarray(rng.randn(n, d).astype(np.float32))
+    b = compute_bins(X, 32)
+    Xb = bin_features(X, b)
+    # distinct targets + weights per member (GBM class-dim shape)
+    Y = jnp.asarray(rng.randn(n, M, 1).astype(np.float32))
+    w = jnp.asarray(rng.rand(n, M).astype(np.float32))
+    masks = jnp.asarray(rng.rand(M, d) > 0.3)
+    kw = dict(max_depth=4, max_bins=32)
+
+    ref = jax.vmap(
+        lambda Ym, wm, fm: fit_tree(Xb, Ym, wm, b.thresholds, fm, **kw),
+        in_axes=(1, 1, 0),
+    )(Y, w, masks)
+    for hist in ("scatter", "matmul"):
+        got = fit_forest(Xb, Y, w, b.thresholds, masks, hist=hist, **kw)
+        np.testing.assert_array_equal(
+            np.asarray(got.split_feature), np.asarray(ref.split_feature), err_msg=hist
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.split_bin), np.asarray(ref.split_bin), err_msg=hist
+        )
+        np.testing.assert_allclose(
+            np.asarray(got.leaf_value),
+            np.asarray(ref.leaf_value),
+            rtol=1e-4,
+            atol=1e-4,
+            err_msg=hist,
+        )
+
+
+def test_fit_forest_multioutput_and_shared_mask():
+    """Fused forest with k>1 targets (bagging-classifier shape) and a single
+    shared feature mask matches the vmapped per-tree fit."""
+    from spark_ensemble_tpu.ops.tree import fit_forest
+
+    rng = np.random.RandomState(9)
+    n, d, M, K = 600, 5, 3, 4
+    X = jnp.asarray(rng.randn(n, d).astype(np.float32))
+    b = compute_bins(X, 16)
+    Xb = bin_features(X, b)
+    ylab = rng.randint(0, K, n)
+    Y1 = jnp.asarray(np.eye(K, dtype=np.float32)[ylab])
+    Y = jnp.broadcast_to(Y1[:, None, :], (n, M, K))
+    w = jnp.asarray(rng.rand(n, M).astype(np.float32))
+    mask = jnp.asarray([True, False, True, True, True])
+    kw = dict(max_depth=3, max_bins=16)
+
+    ref = jax.vmap(
+        lambda Ym, wm: fit_tree(Xb, Ym, wm, b.thresholds, mask, **kw),
+        in_axes=(1, 1),
+    )(Y, w)
+    got = fit_forest(Xb, Y, w, b.thresholds, mask, hist="matmul", **kw)
+    np.testing.assert_array_equal(
+        np.asarray(got.split_feature), np.asarray(ref.split_feature)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.leaf_value), np.asarray(ref.leaf_value), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_fit_forest_sharded_matches_single_device():
+    """Fused forest under shard_map row sharding (psum histograms) == the
+    single-device fused forest."""
+    import functools
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+    from spark_ensemble_tpu.ops.tree import fit_forest
+
+    rng = np.random.RandomState(13)
+    n, d, M = 1024, 4, 3
+    X = jnp.asarray(rng.randn(n, d).astype(np.float32))
+    b = compute_bins(X, 16)
+    Xb = bin_features(X, b)
+    Y = jnp.asarray(rng.randn(n, M, 1).astype(np.float32))
+    w = jnp.ones((n, M))
+    devices = np.array(jax.devices()[:8])
+    mesh = Mesh(devices, ("data",))
+    kw = dict(max_depth=3, max_bins=16, hist="matmul")
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P("data", None), P("data", None, None), P("data", None)),
+        out_specs=P(),
+    )
+    def sharded(Xb_s, Y_s, w_s):
+        return fit_forest(Xb_s, Y_s, w_s, b.thresholds, axis_name="data", **kw)
+
+    got = sharded(Xb, Y, w)
+    ref = fit_forest(Xb, Y, w, b.thresholds, **kw)
+    np.testing.assert_array_equal(
+        np.asarray(got.split_feature), np.asarray(ref.split_feature)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.leaf_value), np.asarray(ref.leaf_value), rtol=1e-4, atol=1e-4
+    )
+
+
 def test_matmul_predict_matches_reference_walk():
     """The path-scoring matmul predict must equal the classic per-level heap
     walk (node = 2*node + 1 + right) bit for bit."""
