@@ -183,6 +183,58 @@ def _flops_per_round(n, d, k, max_depth, max_bins):
     return per_tree * k
 
 
+def _bench_full_extras():
+    """BENCH_FULL=1: wall-clock the other BASELINE.md perf configs
+    (bagging/boosting/gbm-regressor/stacking on their pinned datasets).
+    Returns a dict of extra fields; failures are recorded, not fatal."""
+    import time as _time
+
+    import spark_ensemble_tpu as se
+    from spark_ensemble_tpu.utils.datasets import has_reference_data, load_dataset
+
+    if not has_reference_data():
+        return {"full_error": "reference datasets unavailable"}
+    out = {}
+    cpusmall = load_dataset("cpusmall")
+    adult = load_dataset("adult")
+    cases = {
+        # BaggingRegressor(DT, 10) on cpusmall
+        "bagging_cpusmall_fit_s": lambda: se.BaggingRegressor(
+            num_base_learners=10
+        ).fit(*cpusmall),
+        # BoostingClassifier (depth-1 stumps) on adult
+        "boosting_adult_fit_s": lambda: se.BoostingClassifier(
+            base_learner=se.DecisionTreeClassifier(max_depth=1),
+            num_base_learners=10,
+        ).fit(*adult),
+        # GBMRegressor (squared, 100 rounds) on cpusmall
+        "gbmreg_cpusmall_fit_s": lambda: se.GBMRegressor(
+            num_base_learners=100
+        ).fit(*cpusmall),
+        # StackingClassifier (DT + LR + NB, LR meta) on adult
+        "stacking_adult_fit_s": lambda: se.StackingClassifier(
+            base_learners=[
+                se.DecisionTreeClassifier(),
+                se.LogisticRegression(),
+                se.GaussianNaiveBayes(),
+            ],
+            stacker=se.LogisticRegression(),
+        ).fit(*adult),
+    }
+    for name, fn in cases.items():
+        try:
+            fn()  # warmup/compile
+            t0 = _time.perf_counter()
+            model = fn()
+            import jax as _jax
+
+            _jax.block_until_ready(_jax.tree_util.tree_leaves(model.params))
+            out[name] = round(_time.perf_counter() - t0, 3)
+        except Exception as e:  # noqa: BLE001 - carry the error, keep going
+            out[name + "_error"] = str(e)[:200]
+    return out
+
+
 def inner():
     import numpy as np
 
@@ -230,6 +282,10 @@ def inner():
 
     train_acc = float(np.mean(np.asarray(model.predict(Xd)) == y))
 
+    extras = {}
+    if os.environ.get("BENCH_FULL") == "1":
+        extras = _bench_full_extras()
+
     flops = _flops_per_round(X.shape[0], X.shape[1], 26, 5, 64)
     platform = jax.devices()[0].platform
     # chip peak (dense f32/bf16 mixed); v5e ~197e12 bf16 — rough roofline
@@ -251,6 +307,7 @@ def inner():
                 "mfu_est": round(mfu, 5),
                 "platform": platform,
                 "device": str(jax.devices()[0]),
+                **extras,
             }
         )
     )

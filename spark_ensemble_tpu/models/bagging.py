@@ -19,8 +19,6 @@ BaggingRegressionModel predicts the unweighted mean
 
 from __future__ import annotations
 
-from typing import Any, List, Optional
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P

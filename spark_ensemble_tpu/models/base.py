@@ -243,6 +243,25 @@ class CheckpointableParams(Params):
             p.pop(k, None)
         return p
 
+    @staticmethod
+    def _resume_chunks(st, weights_key: str = "weights"):
+        """Checkpointed members/weights -> round-stacked chunk lists.
+        Handles both the stacked layout (current) and the legacy
+        per-round-list layout."""
+        st_members, st_weights = st["members"], st[weights_key]
+        if isinstance(st_members, list):
+            return (
+                [
+                    jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], m)
+                    for m in st_members
+                ],
+                [jnp.asarray(x, dtype=jnp.float32)[None] for x in st_weights],
+            )
+        return (
+            [jax.tree_util.tree_map(jnp.asarray, st_members)],
+            [jnp.asarray(st_weights, dtype=jnp.float32)],
+        )
+
     def _checkpointer(self, *shape_parts):
         from spark_ensemble_tpu.utils.checkpoint import (
             TrainingCheckpointer,

@@ -40,6 +40,7 @@ from typing import Any, List
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from spark_ensemble_tpu.models.base import (
     BaseLearner,
@@ -52,7 +53,10 @@ from spark_ensemble_tpu.models.base import (
     infer_num_classes,
     resolve_weights,
 )
-from spark_ensemble_tpu.models.gbm import slice_pytree, stack_pytrees
+from spark_ensemble_tpu.models.gbm import (
+    concat_pytrees,
+    slice_pytree,
+)
 from spark_ensemble_tpu.models.tree import (
     DecisionTreeClassifier,
     DecisionTreeRegressor,
@@ -74,6 +78,15 @@ class _BoostingParams(CheckpointableParams, Estimator):
 
     base_learner = Param(None, is_estimator=True)
     num_base_learners = Param(10, gt_eq(1))
+    scan_chunk = Param(
+        16,
+        gt_eq(1),
+        doc="rounds fused into one lax.scan-ed XLA program per dispatch; "
+        "the data-dependent aborts (SAMME err >= 1-1/K, Drucker "
+        "est_err >= 0.5, zero weight mass, perfect fit) are replayed on the "
+        "host after each chunk, reproducing the per-round stopping exactly "
+        "(post-stop rounds in the chunk are discarded)",
+    )
     checkpoint_interval = Param(10, gt_eq(1))
     checkpoint_dir = Param(
         None,
@@ -85,6 +98,54 @@ class _BoostingParams(CheckpointableParams, Estimator):
     )
     aggregation_depth = Param(2, gt_eq(1), doc="API parity; reductions are psum")
     seed = Param(0)
+
+    def _drive_boosting_rounds(
+        self,
+        ckpt,
+        bw,
+        root,
+        members_chunks,
+        weights_chunks,
+        run_chunk,  # (keys [c,2], bw) -> (params [c,...], est_ws [c], sum_bws [c], bw, extras)
+        replay,  # (extras, sum_bws, c, i) -> (#rounds kept, stop?)
+        start_i: int,
+    ) -> int:
+        """Shared chunked round driver for both boosting flavors: chunk
+        clamping to checkpoint boundaries, per-chunk key fan-out, host
+        replay of the flavor's stopping rules, slice-append of kept rounds,
+        and gated periodic saves.  Mutates the chunk lists; returns the
+        final round count."""
+        i = start_i
+        chunk = max(int(self.scan_chunk), 1)
+        stop = float(jnp.sum(bw)) <= 0
+        while i < self.num_base_learners and not stop:
+            c = min(chunk, self.num_base_learners - i)
+            if ckpt.enabled:
+                c = min(c, ckpt.rounds_until_save(i))
+            keys = jax.vmap(lambda j: jax.random.fold_in(root, j))(
+                jnp.arange(i, i + c)
+            )
+            params_c, est_ws, sum_bws, bw, extras = run_chunk(keys, bw)
+            sum_bws = np.asarray(sum_bws)
+            kept, stop = replay(extras, sum_bws, c, i)
+            if not stop:
+                # sequential loop guard for the NEXT round: weight mass
+                # after this chunk's last kept round must stay positive
+                stop = float(sum_bws[c - 1]) <= 0
+            if kept > 0:
+                members_chunks.append(slice_pytree(params_c, kept))
+                weights_chunks.append(est_ws[:kept])
+            i += kept
+            if not stop and ckpt.should_save(i - 1):
+                ckpt.save(
+                    i - 1,
+                    {
+                        "bw": bw,
+                        "members": concat_pytrees(members_chunks),
+                        "est_weights": concat_pytrees(weights_chunks),
+                    },
+                )
+        return i
 
 
 class BoostingClassifier(_BoostingParams):
@@ -142,15 +203,55 @@ class BoostingClassifier(_BoostingParams):
                 new_bw = w_norm * jnp.exp(-((k - 1.0) / k) * ll)
                 return params, err, jnp.asarray(1.0, jnp.float32), new_bw
 
-            return jax.jit(round_real if algorithm == "real" else round_discrete)
+            round_core = round_real if algorithm == "real" else round_discrete
 
-        step = cached_program(
-            ("boosting_cls_round", algorithm, k, base.config_key()), build_step
+            def chunk(ctx, X, y, bw, keys):
+                def body(bw, key):
+                    params, err, est_weight, new_bw = round_core(
+                        ctx, X, y, bw, key
+                    )
+                    return new_bw, (params, err, est_weight, jnp.sum(new_bw))
+
+                bw, (params_c, errs, est_ws, sum_bws) = jax.lax.scan(
+                    body, bw, keys
+                )
+                return params_c, errs, est_ws, sum_bws, bw
+
+            return jax.jit(chunk)
+
+        chunk_step = cached_program(
+            ("boosting_cls_chunk", algorithm, k, base.config_key()), build_step
         )
 
+        def replay(errs, sum_bws, c, i):
+            """Host replay of the per-round aborts over a chunk's outputs:
+            returns (#rounds kept from this chunk, stop?).  Rounds past a
+            stop never ran in the sequential loop; their outputs are
+            discarded."""
+            kept = 0
+            for j in range(c):
+                if j > 0 and float(sum_bws[j - 1]) <= 0:
+                    return kept, True  # sequential loop guard: weight mass 0
+                err = float(errs[j])
+                if algorithm == "discrete" and err >= 1.0 - 1.0 / k:
+                    # abort round, drop model (`BoostingClassifier.scala:252`)
+                    logger.info(
+                        "BoostingClassifier round %d aborted: err=%.4f", i + j, err
+                    )
+                    return kept, True
+                kept = j + 1
+                logger.info("BoostingClassifier round %d: err=%.4f", i + j, err)
+                if err <= 0:
+                    return kept, True
+            return kept, False
+
+        def run_chunk(keys, bw):
+            params_c, errs, est_ws, sum_bws, bw = chunk_step(ctx, X, y, bw, keys)
+            return params_c, est_ws, sum_bws, bw, np.asarray(errs)
+
         bw = w
-        members: List[Any] = []
-        est_weights: List[float] = []
+        members_chunks: List[Any] = []
+        weights_chunks: List[Any] = []
         i = 0
         ckpt = self._checkpointer(n, d, num_classes)
         resumed = ckpt.load_latest()
@@ -158,38 +259,29 @@ class BoostingClassifier(_BoostingParams):
             last_round, st = resumed
             i = last_round + 1
             bw = jnp.asarray(st["bw"])
-            members = list(st["members"])
-            est_weights = [float(x) for x in st["est_weights"]]
+            members_chunks, weights_chunks = self._resume_chunks(
+                st, weights_key="est_weights"
+            )
             logger.info("BoostingClassifier resuming from round %d", i)
-        while i < self.num_base_learners and float(jnp.sum(bw)) > 0:
-            params, err, est_weight, new_bw = step(
-                ctx, X, y, bw, jax.random.fold_in(root, i)
-            )
-            err = float(err)
-            if algorithm == "discrete" and err >= 1.0 - 1.0 / k:
-                # abort round, drop model (`BoostingClassifier.scala:252`)
-                logger.info("BoostingClassifier round %d aborted: err=%.4f", i, err)
-                break
-            members.append(params)
-            est_weights.append(float(est_weight))
-            bw = new_bw
-            logger.info("BoostingClassifier round %d: err=%.4f", i, err)
-            if err <= 0:
-                break
-            ckpt.maybe_save(
-                i, {"bw": bw, "members": members, "est_weights": list(est_weights)}
-            )
-            i += 1
+
+        i = self._drive_boosting_rounds(
+            ckpt, bw, root, members_chunks, weights_chunks, run_chunk, replay, i
+        )
         ckpt.delete()
-        instr.log_outcome(members=len(members))
+        num_members = int(sum(wc.shape[0] for wc in weights_chunks))
+        instr.log_outcome(members=num_members)
         return BoostingClassificationModel(
             params={
-                "members": stack_pytrees(members) if members else None,
-                "weights": jnp.asarray(est_weights, jnp.float32),
+                "members": concat_pytrees(members_chunks)
+                if members_chunks
+                else None,
+                "weights": concat_pytrees(weights_chunks)
+                if weights_chunks
+                else jnp.zeros((0,), jnp.float32),
             },
             num_features=d,
             num_classes=num_classes,
-            num_members=len(members),
+            num_members=num_members,
             **self.get_params(),
         )
 
@@ -298,15 +390,69 @@ class BoostingRegressor(_BoostingParams):
                 new_bw = jnp.where(beta == 0.0, jnp.zeros_like(new_bw), new_bw)
                 return params, max_error, est_err, est_weight, new_bw
 
-            return jax.jit(step)
+            def chunk(ctx, X, y, bw, keys):
+                def body(bw, key):
+                    params, max_error, est_err, est_weight, new_bw = step(
+                        ctx, X, y, bw, key
+                    )
+                    return new_bw, (
+                        params, max_error, est_err, est_weight, jnp.sum(new_bw)
+                    )
 
-        step = cached_program(
-            ("boosting_reg_round", loss_name, base.config_key()), build_step
+                bw, (params_c, max_errs, est_errs, est_ws, sum_bws) = (
+                    jax.lax.scan(body, bw, keys)
+                )
+                return params_c, max_errs, est_errs, est_ws, sum_bws, bw
+
+            return jax.jit(chunk)
+
+        chunk_step = cached_program(
+            ("boosting_reg_chunk", loss_name, base.config_key()), build_step
         )
 
+        def replay(extras, sum_bws, c, i):
+            """Host replay of the Drucker stopping rules (see classifier)."""
+            max_errs, est_errs = extras
+            kept = 0
+            for j in range(c):
+                if j > 0 and float(sum_bws[j - 1]) <= 0:
+                    return kept, True
+                if float(max_errs[j]) == 0.0:
+                    # degenerate perfect fit: keep model, stop
+                    # (`BoostingRegressor.scala:236-239`)
+                    logger.info(
+                        "BoostingRegressor round %d: maxError=0, stopping", i + j
+                    )
+                    return j + 1, True
+                est_err = float(est_errs[j])
+                if est_err >= 0.5:
+                    # drop model and stop (`BoostingRegressor.scala:251`)
+                    logger.info(
+                        "BoostingRegressor round %d dropped: est_err=%.4f",
+                        i + j, est_err,
+                    )
+                    return kept, True
+                kept = j + 1
+                logger.info(
+                    "BoostingRegressor round %d: est_err=%.4f", i + j, est_err
+                )
+            return kept, False
+
+        def run_chunk(keys, bw):
+            params_c, max_errs, est_errs, est_ws, sum_bws, bw = chunk_step(
+                ctx, X, y, bw, keys
+            )
+            return (
+                params_c,
+                est_ws,
+                sum_bws,
+                bw,
+                (np.asarray(max_errs), np.asarray(est_errs)),
+            )
+
         bw = w
-        members: List[Any] = []
-        est_weights: List[float] = []
+        members_chunks: List[Any] = []
+        weights_chunks: List[Any] = []
         i = 0
         ckpt = self._checkpointer(n, d)
         resumed = ckpt.load_latest()
@@ -314,44 +460,28 @@ class BoostingRegressor(_BoostingParams):
             last_round, st = resumed
             i = last_round + 1
             bw = jnp.asarray(st["bw"])
-            members = list(st["members"])
-            est_weights = [float(x) for x in st["est_weights"]]
+            members_chunks, weights_chunks = self._resume_chunks(
+                st, weights_key="est_weights"
+            )
             logger.info("BoostingRegressor resuming from round %d", i)
-        while i < self.num_base_learners and float(jnp.sum(bw)) > 0:
-            params, max_error, est_err, est_weight, new_bw = step(
-                ctx, X, y, bw, jax.random.fold_in(root, i)
-            )
-            est_err = float(est_err)
-            if float(max_error) == 0.0:
-                # degenerate perfect fit: keep model, stop
-                # (`BoostingRegressor.scala:236-239`)
-                members.append(params)
-                est_weights.append(float(est_weight))
-                logger.info("BoostingRegressor round %d: maxError=0, stopping", i)
-                break
-            if est_err >= 0.5:
-                # drop model and stop (`BoostingRegressor.scala:251`)
-                logger.info(
-                    "BoostingRegressor round %d dropped: est_err=%.4f", i, est_err
-                )
-                break
-            members.append(params)
-            est_weights.append(float(est_weight))
-            bw = new_bw
-            logger.info("BoostingRegressor round %d: est_err=%.4f", i, est_err)
-            ckpt.maybe_save(
-                i, {"bw": bw, "members": members, "est_weights": list(est_weights)}
-            )
-            i += 1
+
+        i = self._drive_boosting_rounds(
+            ckpt, bw, root, members_chunks, weights_chunks, run_chunk, replay, i
+        )
         ckpt.delete()
-        instr.log_outcome(members=len(members))
+        num_members = int(sum(wc.shape[0] for wc in weights_chunks))
+        instr.log_outcome(members=num_members)
         return BoostingRegressionModel(
             params={
-                "members": stack_pytrees(members) if members else None,
-                "weights": jnp.asarray(est_weights, jnp.float32),
+                "members": concat_pytrees(members_chunks)
+                if members_chunks
+                else None,
+                "weights": concat_pytrees(weights_chunks)
+                if weights_chunks
+                else jnp.zeros((0,), jnp.float32),
             },
             num_features=d,
-            num_members=len(members),
+            num_members=num_members,
             **self.get_params(),
         )
 

@@ -12,8 +12,6 @@ Used standalone as baselines and, critically, as GBM's init model
 
 from __future__ import annotations
 
-from typing import Any, Optional
-
 import jax
 import jax.numpy as jnp
 

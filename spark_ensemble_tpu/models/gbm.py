@@ -51,7 +51,7 @@ reference's entire distribution story, `GBMClassifier.scala:325-483`):
 from __future__ import annotations
 
 import logging
-from typing import Any, List, Optional
+from typing import Any, List
 
 import jax
 import jax.numpy as jnp
@@ -86,10 +86,6 @@ from spark_ensemble_tpu.utils.random import (
 )
 
 logger = logging.getLogger(__name__)
-
-
-def stack_pytrees(trees: List[Any]):
-    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
 
 
 def _pad_rows(arr, n_pad: int):
@@ -250,25 +246,6 @@ class _GBMParams(CheckpointableParams, Estimator):
                     )
                 )
             ),
-        )
-
-    @staticmethod
-    def _resume_chunks(st):
-        """Checkpointed members/weights -> round-stacked chunk lists.
-        Handles both the stacked layout (current) and the legacy
-        per-round-list layout."""
-        st_members, st_weights = st["members"], st["weights"]
-        if isinstance(st_members, list):
-            return (
-                [
-                    jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], m)
-                    for m in st_members
-                ],
-                [jnp.asarray(x)[None] for x in st_weights],
-            )
-        return (
-            [jax.tree_util.tree_map(jnp.asarray, st_members)],
-            [jnp.asarray(st_weights)],
         )
 
     def _drive_rounds(

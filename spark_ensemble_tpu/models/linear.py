@@ -20,8 +20,6 @@ mask), matching the reference's slice-projection semantics
 
 from __future__ import annotations
 
-from typing import Any, Optional
-
 import jax
 import jax.numpy as jnp
 import optax

@@ -22,7 +22,7 @@ sample weights get them dropped with a warning
 from __future__ import annotations
 
 import logging
-from typing import Any, List, Optional
+from typing import List
 
 import jax
 import jax.numpy as jnp

@@ -11,8 +11,6 @@ histogram bins are cheap on TPU.
 
 from __future__ import annotations
 
-from typing import Any, Optional
-
 import jax
 import jax.numpy as jnp
 
@@ -24,13 +22,12 @@ from spark_ensemble_tpu.models.base import (
     RegressionModel,
     as_f32,
 )
-from spark_ensemble_tpu.ops.binning import Bins, bin_features, compute_bins
+from spark_ensemble_tpu.ops.binning import bin_features, compute_bins
 from spark_ensemble_tpu.ops.tree import (
     Tree,
     fit_forest,
     fit_tree,
     predict_tree,
-    predict_tree_binned,
 )
 from spark_ensemble_tpu.params import Param, gt_eq, in_range
 

@@ -13,7 +13,7 @@ trained on binned features predict on raw ones.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -47,7 +47,6 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from spark_ensemble_tpu.ops.binning import Bins
 from spark_ensemble_tpu.ops.collective import preduce as _preduce
 
 

@@ -28,7 +28,7 @@ import numpy as np
 
 from spark_ensemble_tpu.evaluation import Evaluator
 from spark_ensemble_tpu.models.base import Estimator, Model
-from spark_ensemble_tpu.params import Param, Params, gt_eq, in_range
+from spark_ensemble_tpu.params import Param, gt_eq, in_range
 
 logger = logging.getLogger(__name__)
 

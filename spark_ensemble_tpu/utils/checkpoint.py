@@ -18,7 +18,6 @@ import shutil
 import tempfile
 from typing import Any, Dict, Optional, Tuple
 
-import jax.numpy as jnp
 import numpy as np
 
 

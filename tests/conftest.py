@@ -28,6 +28,27 @@ import pytest
 from spark_ensemble_tpu.utils import datasets as ds
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _bound_compiled_program_accumulation():
+    """Free compiled XLA executables between test modules.
+
+    A full single-process run of this suite compiles hundreds of programs
+    (including the large scan-chunked round loops); on this jax/jaxlib
+    (0.9.0) the CPU backend segfaults inside `backend_compile_and_load`
+    after ~130 tests' worth of accumulated executables — reproducibly, at
+    whichever compile happens to run late in the suite, with RSS only a few
+    GB (an XLA-internal resource limit, not host OOM).  Dropping the
+    process-wide program cache and jax's compiled-function caches at module
+    boundaries keeps the live-executable population bounded and the full
+    suite green; per-module reuse (the hot path) is unaffected.
+    """
+    yield
+    from spark_ensemble_tpu.models.base import _PROGRAM_CACHE
+
+    _PROGRAM_CACHE.clear()
+    jax.clear_caches()
+
+
 def _synthetic_regression(n=2000, d=12, seed=0):
     rng = np.random.RandomState(seed)
     X = rng.randn(n, d).astype(np.float32)

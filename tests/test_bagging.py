@@ -2,7 +2,6 @@
 `BaggingClassifierSuite.scala:48-182`)."""
 
 import numpy as np
-import pytest
 
 import spark_ensemble_tpu as se
 from tests.conftest import accuracy, rmse, split

@@ -2,7 +2,6 @@
 `BoostingRegressorSuite.scala:78-182`)."""
 
 import numpy as np
-import pytest
 
 import spark_ensemble_tpu as se
 from tests.conftest import accuracy, rmse, split
@@ -119,3 +118,31 @@ def test_round_program_not_stale_after_set_params():
     fresh = se.BoostingRegressor(loss="exponential", num_base_learners=3, seed=1)
     want = np.asarray(fresh.fit(X2, y2).predict(X2[:50]))
     assert np.allclose(got, want, atol=1e-5)
+
+
+def test_boosting_scan_chunk_invariance(letter, cpusmall):
+    """Chunked dispatch must reproduce the per-round loop exactly — same
+    member count (stop replay) and identical predictions — for both
+    flavors, including mid-chunk stops."""
+    X, y = letter
+    Xr, yr = cpusmall
+    cls = [
+        se.BoostingClassifier(num_base_learners=7, scan_chunk=c, seed=2).fit(X, y)
+        for c in (1, 4)
+    ]
+    assert cls[0].num_members == cls[1].num_members
+    np.testing.assert_allclose(
+        np.asarray(cls[0].predict_raw(X[:200])),
+        np.asarray(cls[1].predict_raw(X[:200])),
+        rtol=1e-5, atol=1e-5,
+    )
+    regs = [
+        se.BoostingRegressor(num_base_learners=7, scan_chunk=c, seed=2).fit(Xr, yr)
+        for c in (1, 4)
+    ]
+    assert regs[0].num_members == regs[1].num_members
+    np.testing.assert_allclose(
+        np.asarray(regs[0].predict(Xr[:200])),
+        np.asarray(regs[1].predict(Xr[:200])),
+        rtol=1e-5, atol=1e-5,
+    )

@@ -2,7 +2,6 @@
 real mid-run resumability where the reference only truncated RDD lineage)."""
 
 import numpy as np
-import pytest
 
 import spark_ensemble_tpu as se
 from spark_ensemble_tpu.utils.checkpoint import TrainingCheckpointer

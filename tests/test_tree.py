@@ -3,7 +3,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from spark_ensemble_tpu.ops.binning import bin_features, compute_bins
 from spark_ensemble_tpu.ops.tree import fit_tree, predict_tree, predict_tree_binned
