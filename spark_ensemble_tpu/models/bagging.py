@@ -164,8 +164,7 @@ class BaggingRegressionModel(RegressionModel, BaggingRegressor):
     def member_predictions(self, X):
         base = self._base()
         fn = self._cached_jit(
-            "members",
-            lambda members, Xq: jax.vmap(lambda p: base.predict_fn(p, Xq))(members),
+            "members", lambda members, Xq: base.predict_many_fn(members, Xq)
         )
         return fn(self.params["members"], as_f32(X))  # [m, n]
 
@@ -222,8 +221,7 @@ class BaggingClassificationModel(ClassificationModel, BaggingClassifier):
         `BaggingClassifierSuite.scala:80-155`)."""
         base = self._base()
         fn = self._cached_jit(
-            "member_preds",
-            lambda members, Xq: jax.vmap(lambda p: base.predict_fn(p, Xq))(members),
+            "member_preds", lambda members, Xq: base.predict_many_fn(members, Xq)
         )
         return fn(self.params["members"], as_f32(X))
 
@@ -233,7 +231,7 @@ class BaggingClassificationModel(ClassificationModel, BaggingClassifier):
             fn = self._cached_jit(
                 "raw_soft",
                 lambda members, Xq: jnp.sum(
-                    jax.vmap(lambda p: base.predict_proba_fn(p, Xq))(members), axis=0
+                    base.predict_proba_many_fn(members, Xq), axis=0
                 ),
             )
         else:
@@ -242,10 +240,7 @@ class BaggingClassificationModel(ClassificationModel, BaggingClassifier):
                 "raw_hard",
                 lambda members, Xq: jnp.sum(
                     jax.nn.one_hot(
-                        jax.vmap(lambda p: base.predict_fn(p, Xq))(members).astype(
-                            jnp.int32
-                        ),
-                        k,
+                        base.predict_many_fn(members, Xq).astype(jnp.int32), k
                     ),
                     axis=0,
                 ),

@@ -378,6 +378,17 @@ class BaseLearner(Estimator):
         """Regression value [n] (regressors) or class index f32[n] (classifiers)."""
         raise NotImplementedError
 
+    def predict_many_fn(self, params: Any, X: jax.Array) -> jax.Array:
+        """Stacked-member predict -> [M, n].  Default: vmap of
+        ``predict_fn``; learners with a fused multi-member kernel (trees:
+        one column-select matmul for all members, ``ops.tree.predict_forest``)
+        override this — ensemble model predict paths route through it."""
+        return jax.vmap(lambda p: self.predict_fn(p, X))(params)
+
+    def predict_proba_many_fn(self, params: Any, X: jax.Array) -> jax.Array:
+        """Stacked-member probabilities -> [M, n, k]; default vmap."""
+        return jax.vmap(lambda p: self.predict_proba_fn(p, X))(params)
+
     def predict_raw_fn(self, params: Any, X: jax.Array) -> jax.Array:
         raise NotImplementedError
 

@@ -264,7 +264,7 @@ class BoostingClassifier(_BoostingParams):
             )
             logger.info("BoostingClassifier resuming from round %d", i)
 
-        i = self._drive_boosting_rounds(
+        self._drive_boosting_rounds(
             ckpt, bw, root, members_chunks, weights_chunks, run_chunk, replay, i
         )
         ckpt.delete()
@@ -300,7 +300,7 @@ class BoostingClassificationModel(ClassificationModel, BoostingClassifier):
         if self.algorithm.lower() == "real":
 
             def raw_real(members, weights, Xq):
-                probas = jax.vmap(lambda p: base.predict_proba_fn(p, Xq))(members)
+                probas = base.predict_proba_many_fn(members, Xq)
                 logp = jnp.log(jnp.maximum(probas, EPSILON))
                 decisions = logp - jnp.mean(logp, axis=-1, keepdims=True)
                 return (k - 1.0) * jnp.sum(decisions, axis=0)
@@ -309,7 +309,7 @@ class BoostingClassificationModel(ClassificationModel, BoostingClassifier):
         else:
 
             def raw_discrete(members, weights, Xq):
-                preds = jax.vmap(lambda p: base.predict_fn(p, Xq))(members)
+                preds = base.predict_many_fn(members, Xq)
                 onehot = jax.nn.one_hot(preds.astype(jnp.int32), k)
                 votes = jnp.where(onehot > 0, 1.0, -1.0 / (k - 1.0))
                 return jnp.einsum("m,mnk->nk", weights, votes)
@@ -465,7 +465,7 @@ class BoostingRegressor(_BoostingParams):
             )
             logger.info("BoostingRegressor resuming from round %d", i)
 
-        i = self._drive_boosting_rounds(
+        self._drive_boosting_rounds(
             ckpt, bw, root, members_chunks, weights_chunks, run_chunk, replay, i
         )
         ckpt.delete()
@@ -494,8 +494,7 @@ class BoostingRegressionModel(RegressionModel, BoostingRegressor):
     def member_predictions(self, X):
         base = self._base()
         fn = self._cached_jit(
-            "members",
-            lambda members, Xq: jax.vmap(lambda p: base.predict_fn(p, Xq))(members),
+            "members", lambda members, Xq: base.predict_many_fn(members, Xq)
         )
         return fn(self.params["members"], as_f32(X))  # [m, n]
 

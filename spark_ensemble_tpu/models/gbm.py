@@ -738,9 +738,7 @@ class GBMRegressionModel(RegressionModel, GBMRegressor):
         fn = self._cached_jit(
             "predict",
             lambda members, weights, Xq: jnp.einsum(
-                "m,mn->n",
-                weights,
-                jax.vmap(lambda p: base.predict_fn(p, Xq))(members),
+                "m,mn->n", weights, base.predict_many_fn(members, Xq)
             ),
         )
         return out + fn(self.params["members"], self.params["weights"], X)
@@ -1159,14 +1157,18 @@ class GBMClassificationModel(ClassificationModel, GBMClassifier):
         if self.num_members == 0:
             return out
         base = self._base()
-        fn = self._cached_jit(
-            "raw",
-            lambda members, weights, Xq: jnp.einsum(
-                "md,mdn->nd",
-                weights,
-                jax.vmap(jax.vmap(lambda p: base.predict_fn(p, Xq)))(members),
-            ),
-        )
+        def raw(members, weights, Xq):
+            # [R, dim] member grid flattened so the base learner's fused
+            # multi-member predict covers every (round, class-dim) tree in
+            # one kernel (ops/tree.py predict_forest)
+            r, dim = weights.shape
+            flat = jax.tree_util.tree_map(
+                lambda x: x.reshape((r * dim,) + x.shape[2:]), members
+            )
+            preds = base.predict_many_fn(flat, Xq).reshape(r, dim, -1)
+            return jnp.einsum("md,mdn->nd", weights, preds)
+
+        fn = self._cached_jit("raw", raw)
         return out + fn(self.params["members"], self.params["weights"], X)
 
     def predict_raw(self, X):

@@ -27,6 +27,7 @@ from spark_ensemble_tpu.ops.tree import (
     Tree,
     fit_forest,
     fit_tree,
+    predict_forest,
     predict_tree,
 )
 from spark_ensemble_tpu.params import Param, gt_eq, in_range
@@ -102,6 +103,9 @@ class DecisionTreeRegressor(_TreeLearner):
     def predict_fn(self, params: Tree, X):
         return predict_tree(params, X)[:, 0]
 
+    def predict_many_fn(self, params: Tree, X):
+        return predict_forest(params, X)[:, :, 0]
+
     def model_from_params(self, params, num_features, num_classes=None):
         return DecisionTreeRegressionModel(
             params=params, num_features=num_features, **self.get_params()
@@ -128,6 +132,13 @@ class DecisionTreeClassifier(_TreeLearner):
         # leaf values are weighted one-hot means: a probability vector up to
         # zero-weight fallbacks; renormalize defensively
         p = jnp.maximum(predict_tree(params, X), 0.0)
+        return p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+
+    def predict_many_fn(self, params: Tree, X):
+        return jnp.argmax(predict_forest(params, X), axis=-1).astype(jnp.float32)
+
+    def predict_proba_many_fn(self, params: Tree, X):
+        p = jnp.maximum(predict_forest(params, X), 0.0)
         return p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
 
     def predict_raw_fn(self, params: Tree, X):
