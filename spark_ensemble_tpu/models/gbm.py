@@ -143,10 +143,6 @@ def _mesh_row_spec(mesh: Mesh):
     return axes if len(axes) > 1 else "data"
 
 
-def index_pytree(tree: Any, i):
-    return jax.tree_util.tree_map(lambda x: x[i], tree)
-
-
 def slice_pytree(tree: Any, n: int):
     return jax.tree_util.tree_map(lambda x: x[:n], tree)
 
@@ -182,10 +178,11 @@ class _GBMParams(CheckpointableParams, Estimator):
     scan_chunk = Param(
         16,
         gt_eq(1),
-        doc="rounds fused into one lax.scan-ed XLA program on the "
-        "single-program (mesh=None) path; amortizes per-dispatch overhead "
-        "without changing round math (validation early-stop still applies "
-        "per round, overshooting at most one chunk of compute)",
+        doc="rounds fused into one lax.scan-ed XLA program per dispatch "
+        "(single-chip, and under a mesh when no validation split needs "
+        "per-round evaluation); amortizes dispatch overhead without "
+        "changing round math (validation early-stop still applies per "
+        "round, overshooting at most one chunk of compute)",
     )
     checkpoint_interval = Param(10, gt_eq(1))
     checkpoint_dir = Param(
@@ -250,20 +247,21 @@ class _GBMParams(CheckpointableParams, Estimator):
 
     def _drive_rounds(
         self,
-        mesh,
+        use_chunks: bool,
         ckpt,
         members_chunks: List[Any],
         weights_chunks: List[Any],
         run_chunk,  # (sl: slice) -> (params [c,...], weights [c,...], errs|None)
-        run_round,  # (i: int) -> (params, weight, err|None)   [mesh path]
+        run_round,  # (i: int) -> (params, weight, err|None)   [per-round path]
         save_state,  # (round_idx, v, best) -> None  (must self-gate)
         label: str,
         i: int,
         v: int,
         best: float,
     ):
-        """The shared round-loop driver: scan-chunked dispatch on the
-        single-program path, per-round dispatch under a mesh; patience
+        """The shared round-loop driver: scan-chunked dispatch (single
+        program per `scan_chunk` rounds — also under a mesh when there is no
+        validation stop to evaluate), per-round dispatch otherwise; patience
         bookkeeping, mid-chunk stop accounting, and periodic state saves are
         identical for both GBM flavors.  ``run_chunk``/``run_round`` own the
         prediction-state updates (via closure); extra members computed past a
@@ -271,7 +269,7 @@ class _GBMParams(CheckpointableParams, Estimator):
         ``keep = i - v`` slice."""
         chunk = max(int(self.scan_chunk), 1)
         while i < self.num_base_learners and v < self.num_rounds:
-            if mesh is None:
+            if use_chunks:
                 c = min(chunk, self.num_base_learners - i)
                 if ckpt.enabled:
                     # end the chunk exactly on the next save boundary: keeps
@@ -566,6 +564,55 @@ class GBMRegressor(_GBMParams):
 
             return jax.jit(chunk)
 
+        def build_chunk_step_mesh():
+            """Scan-chunked rounds as ONE shard_map-ed SPMD program — the
+            distributed path gets the same dispatch amortization as the
+            single-chip path (no validation state to evaluate per round on
+            this path; mesh+validation stays per-round)."""
+            round_core = make_round_core()
+
+            def chunk(ctx, X, y, w, valid_w, pred, delta, bag_ws, keys, masks):
+                def body(carry, xs):
+                    pred, delta = carry
+                    bag_w, key, mask = xs
+                    if huber:
+                        # shard-local |residual| + all_gather inside the
+                        # quantile: identical global delta on every shard
+                        delta = weighted_quantile(
+                            jnp.abs(y - pred), alpha_q, weights=valid_w,
+                            axis_name=ax,
+                        )
+                    params, weight, new_pred = round_core(
+                        ctx, X, bag_w, key, mask, pred, delta, y, w
+                    )
+                    return (new_pred, delta), (params, weight)
+
+                (pred, delta), (params_all, weights_all) = jax.lax.scan(
+                    body, (pred, delta), (bag_ws, keys, masks)
+                )
+                return params_all, weights_all, pred, delta
+
+            return jax.jit(
+                shard_map(
+                    chunk,
+                    mesh=mesh,
+                    in_specs=(
+                        base.ctx_specs(ctx, ax),
+                        P(ax, None),  # X
+                        P(ax),  # y
+                        P(ax),  # w
+                        P(ax),  # valid_w
+                        P(ax),  # pred
+                        P(),  # delta
+                        P(None, ax),  # bag_ws [c, n_pad]
+                        P(),  # keys [c, 2]
+                        P(),  # masks [c, d]
+                    ),
+                    out_specs=(P(), P(), P(ax), P()),
+                    check_vma=False,
+                )
+            )
+
         round_key = (
             "gbm_reg_round",
             loss_name,
@@ -580,14 +627,21 @@ class GBMRegressor(_GBMParams):
             base_key,
             mesh,
         )
-        if mesh is not None:
+        use_chunks = mesh is None or not with_validation
+        if not use_chunks:
             round_step = cached_program(round_key, build_round_step)
             bag_fn = self._make_bag_fn(n, n_pad)
         else:
-            chunk_step = cached_program(
-                round_key + ("chunk", huber, with_validation), build_chunk_step
-            )
             bag_many = self._make_bag_many_fn(n, n_pad)
+            if mesh is not None:
+                chunk_step = cached_program(
+                    round_key + ("chunk_mesh", huber), build_chunk_step_mesh
+                )
+            else:
+                chunk_step = cached_program(
+                    round_key + ("chunk", huber, with_validation),
+                    build_chunk_step,
+                )
 
         eval_loss = cached_program(
             ("gbm_reg_eval", loss_name, alpha_q),
@@ -668,6 +722,12 @@ class GBMRegressor(_GBMParams):
 
         def run_chunk(sl):
             nonlocal pred, pred_val, delta
+            if mesh is not None:
+                params_c, weights_c, pred, delta = chunk_step(
+                    ctx, X, y, w, valid_w, pred, delta,
+                    bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
+                )
+                return params_c, weights_c, None
             params_c, weights_c, errs, pred, pred_val_new, delta = chunk_step(
                 ctx, X, y, w, valid_w, pred,
                 pred_val if with_validation else val_dummy,
@@ -696,7 +756,7 @@ class GBMRegressor(_GBMParams):
             return params, weight, err
 
         i, v, best = self._drive_rounds(
-            mesh, ckpt, members_chunks, weights_chunks,
+            use_chunks, ckpt, members_chunks, weights_chunks,
             run_chunk, run_round, save_state, "GBMRegressor", i, v, best,
         )
         ckpt.delete()
@@ -1000,6 +1060,47 @@ class GBMClassifier(_GBMParams):
 
             return jax.jit(chunk)
 
+        def build_chunk_step_mesh():
+            """Scan-chunked rounds as ONE shard_map-ed SPMD program (see
+            GBMRegressor.build_chunk_step_mesh)."""
+            round_core = make_round_core()
+
+            def chunk(ctx, X, y_enc, w, pred, bag_ws, keys, masks):
+                def body(pred, xs):
+                    bag_w, key, mask = xs
+                    params, weight, new_pred = round_core(
+                        ctx, X, y_enc, w, bag_w, key, mask, pred
+                    )
+                    return new_pred, (params, weight)
+
+                pred, (params_all, weights_all) = jax.lax.scan(
+                    body, pred, (bag_ws, keys, masks)
+                )
+                return params_all, weights_all, pred
+
+            return jax.jit(
+                shard_map(
+                    chunk,
+                    mesh=mesh,
+                    in_specs=(
+                        base.ctx_specs(ctx, ax),
+                        P(ax, None),  # X
+                        P(ax, None),  # y_enc
+                        P(ax),  # w
+                        P(ax, None),  # pred
+                        P(None, ax),  # bag_ws [c, n_pad]
+                        P(),  # keys [c, 2]
+                        P(),  # masks [c, d]
+                    ),
+                    out_specs=(
+                        P(None, "member") if member_size > 1 else P(),
+                        P(),
+                        P(ax, None),
+                    ),
+                    check_vma=False,
+                )
+            )
+
         round_key = (
             "gbm_cls_round",
             loss_name,
@@ -1014,14 +1115,20 @@ class GBMClassifier(_GBMParams):
             base_key,
             mesh,
         )
-        if mesh is not None:
+        use_chunks = mesh is None or not with_validation
+        if not use_chunks:
             round_step = cached_program(round_key, build_round_step)
             bag_fn = self._make_bag_fn(n, n_pad)
         else:
-            chunk_step = cached_program(
-                round_key + ("chunk", with_validation), build_chunk_step
-            )
             bag_many = self._make_bag_many_fn(n, n_pad)
+            if mesh is not None:
+                chunk_step = cached_program(
+                    round_key + ("chunk_mesh",), build_chunk_step_mesh
+                )
+            else:
+                chunk_step = cached_program(
+                    round_key + ("chunk", with_validation), build_chunk_step
+                )
 
         eval_loss = cached_program(
             ("gbm_cls_eval", loss_name, num_classes),
@@ -1087,6 +1194,12 @@ class GBMClassifier(_GBMParams):
 
         def run_chunk(sl):
             nonlocal pred, pred_val
+            if mesh is not None:
+                params_c, weights_c, pred = chunk_step(
+                    ctx, X, y_enc, w, pred,
+                    bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
+                )
+                return params_c, weights_c, None
             params_c, weights_c, errs, pred, pred_val_new = chunk_step(
                 ctx, X, y_enc, w, pred,
                 pred_val if with_validation else val_dummy,
@@ -1112,7 +1225,7 @@ class GBMClassifier(_GBMParams):
             return params, weight, err
 
         i, v, best = self._drive_rounds(
-            mesh, ckpt, members_chunks, weights_chunks,
+            use_chunks, ckpt, members_chunks, weights_chunks,
             run_chunk, run_round, save_state, "GBMClassifier", i, v, best,
         )
         ckpt.delete()
