@@ -212,3 +212,22 @@ def test_gbm_hybrid_mesh_parity():
     assert np.mean(ps == pd) > 0.97
     acc_s, acc_d = float(np.mean(ps == y)), float(np.mean(pd == y))
     assert abs(acc_s - acc_d) < 0.02, (acc_s, acc_d)
+
+
+def test_gbm_mesh_scan_chunk_invariance(mesh42):
+    """The chunked SPMD dispatch must produce the same model as chunk=1 on
+    the same mesh — identical psum points, identical per-round math, only
+    dispatch granularity differs (pointwise: same reduction order)."""
+    X, y = _cls_data()
+    models = [
+        GBMClassifier(
+            num_base_learners=4, loss="logloss", updates="newton",
+            learning_rate=0.5, seed=6, scan_chunk=c,
+        ).fit(X, y, mesh=mesh42)
+        for c in (1, 3)
+    ]
+    np.testing.assert_allclose(
+        np.asarray(models[0].predict_raw(X[:200])),
+        np.asarray(models[1].predict_raw(X[:200])),
+        rtol=1e-5, atol=1e-5,
+    )

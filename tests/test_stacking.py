@@ -118,3 +118,29 @@ def test_parallel_fits_match_sequential():
         np.asarray(seq.predict_raw(X)), np.asarray(par.predict_raw(X)),
         rtol=1e-5, atol=1e-5,
     )
+
+
+def test_logistic_solvers_agree(adult):
+    """Newton (exact softmax-CE Hessian) and LBFGS must reach the same
+    optimum — same accuracy and near-identical probabilities — on both
+    binary (sigmoid-reduced path) and multiclass problems."""
+    X, y = adult
+    ms = [
+        se.LogisticRegression(solver=s).fit(X, y) for s in ("newton", "lbfgs")
+    ]
+    a0 = accuracy(ms[0].predict(X), y)
+    a1 = accuracy(ms[1].predict(X), y)
+    assert abs(a0 - a1) < 0.005, (a0, a1)
+    p0 = np.asarray(ms[0].predict_proba(X[:500]))
+    p1 = np.asarray(ms[1].predict_proba(X[:500]))
+    assert np.max(np.abs(p0 - p1)) < 0.01
+
+    rng = np.random.RandomState(2)
+    Xm = rng.randn(1200, 6).astype(np.float32)
+    centers = rng.randn(4, 6).astype(np.float32)
+    ym = np.argmax(Xm @ centers.T, axis=1).astype(np.float32)
+    mm = [
+        se.LogisticRegression(solver=s).fit(Xm, ym) for s in ("newton", "lbfgs")
+    ]
+    am = [accuracy(m.predict(Xm), ym) for m in mm]
+    assert abs(am[0] - am[1]) < 0.01, am
