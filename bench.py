@@ -173,6 +173,12 @@ def _load_letter():
     return X, y
 
 
+def _peak_flops(platform: str) -> float:
+    """Rough dense-matmul peak for the MFU estimate (v5e bf16 ~197 TFLOP/s;
+    nominal 1 TFLOP/s for the CPU fallback)."""
+    return 197e12 if platform != "cpu" else 1e12
+
+
 def _flops_per_round(n, d, k, max_depth, max_bins):
     """FLOP estimate for one GBM round, matmul-histogram path: per level,
     H = A^T[nodes*(1+1), n] @ bin_oh[n, d*B] per class dim, plus leaf pass."""
@@ -235,6 +241,52 @@ def _bench_full_extras():
     return out
 
 
+def _bench_large_extras():
+    """BENCH_LARGE=1: a synthetic large-batch GBM config (n=131072, d=32,
+    8 classes) where the histogram matmuls dominate dispatch — the MFU
+    scaling point BASELINE.md's roofline note predicts.  Extra JSON fields;
+    failures recorded, not fatal."""
+    import time as _time
+
+    import numpy as np
+
+    import jax
+
+    from spark_ensemble_tpu import GBMClassifier
+
+    try:
+        n, d, k = 131072, 32, 8
+        rng = np.random.RandomState(0)
+        X = rng.randn(n, d).astype(np.float32)
+        centers = rng.randn(k, d).astype(np.float32)
+        y = np.argmax(X @ centers.T + 0.5 * rng.randn(n, k), axis=1).astype(
+            np.float32
+        )
+        rounds = _env_int("BENCH_LARGE_ROUNDS", 20)
+        est = GBMClassifier(
+            num_base_learners=rounds, loss="logloss", updates="newton",
+            learning_rate=0.3,
+        )
+        # warmup with the SAME round count: the scan-chunked loop compiles
+        # one program per distinct chunk length (16 and the remainder), so a
+        # 1-round warmup would leave both compiles inside the timed window
+        est.fit(X, y)
+        t0 = _time.perf_counter()
+        model = est.fit(X, y)
+        jax.block_until_ready(jax.tree_util.tree_leaves(model.params))
+        fit_s = _time.perf_counter() - t0
+        flops = _flops_per_round(n, d, k, 5, 64)
+        peak = _peak_flops(jax.devices()[0].platform)
+        return {
+            "large_iters_per_sec": round(rounds / fit_s, 3),
+            "large_fit_seconds": round(fit_s, 2),
+            "large_config": f"synthetic n={n} d={d} k={k} rounds={rounds}",
+            "large_mfu_est": round(flops * (rounds / fit_s) / peak, 5),
+        }
+    except Exception as e:  # noqa: BLE001 - carry the error, keep going
+        return {"large_error": str(e)[:200]}
+
+
 def inner():
     import numpy as np
 
@@ -258,11 +310,10 @@ def inner():
         optimized_weights=True,
     )
 
-    # warmup: compile the round step on one round (cached for the full run)
-    warm = GBMClassifier(
-        num_base_learners=1, loss="logloss", updates="newton", learning_rate=0.3
-    )
-    warm.fit(X, y)
+    # warmup with the SAME config and round count: the scan-chunked loop
+    # compiles one program per distinct chunk length, so a 1-round warmup
+    # would leave the length-16 and remainder compiles in the timed window
+    est.fit(X, y)
 
     t0 = time.perf_counter()
     model = est.fit(X, y)
@@ -285,11 +336,12 @@ def inner():
     extras = {}
     if os.environ.get("BENCH_FULL") == "1":
         extras = _bench_full_extras()
+    if os.environ.get("BENCH_LARGE") == "1":
+        extras.update(_bench_large_extras())
 
     flops = _flops_per_round(X.shape[0], X.shape[1], 26, 5, 64)
     platform = jax.devices()[0].platform
-    # chip peak (dense f32/bf16 mixed); v5e ~197e12 bf16 — rough roofline
-    peak = 197e12 if platform != "cpu" else 1e12
+    peak = _peak_flops(platform)
     mfu = flops * iters_per_sec / peak
 
     print(
