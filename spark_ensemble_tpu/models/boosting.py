@@ -9,6 +9,14 @@ reweighting — is ONE jitted XLA program; the boosting weight vector lives on
 device across rounds (the reference carries it as an RDD with
 ``treeReduce`` sums and periodic lineage checkpoints, all unnecessary here).
 
+Distributed: ``fit(..., mesh=...)`` shards rows (and the boosting weight
+vector) over the mesh's "data" axis and runs each scan-chunk of rounds as a
+single shard_map-ed SPMD program — weight-mass/error sums become psum,
+Drucker's ``maxError`` becomes pmax, and the base fit psums its sufficient
+statistics over the same axis.  This is the XLA mapping of the reference's
+executor-side round reductions (`BoostingClassifier.scala:175,235-242`,
+`BoostingRegressor.scala:232-249`) with the host replay of aborts unchanged.
+
 Formula parity:
 - SAMME ("discrete"): err = sum(w_norm * 1[miss]); beta =
   err / ((1-err)(K-1)); estimator weight log(1/beta) (1.0 if beta == 0);
@@ -41,6 +49,8 @@ from typing import Any, List
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from spark_ensemble_tpu.models.base import (
     BaseLearner,
@@ -54,13 +64,16 @@ from spark_ensemble_tpu.models.base import (
     resolve_weights,
 )
 from spark_ensemble_tpu.models.gbm import (
+    _mesh_row_spec,
     concat_pytrees,
+    setup_row_sharding,
     slice_pytree,
 )
 from spark_ensemble_tpu.models.tree import (
     DecisionTreeClassifier,
     DecisionTreeRegressor,
 )
+from spark_ensemble_tpu.ops.collective import pmax_reduce, preduce
 from spark_ensemble_tpu.params import Param, gt_eq, in_array
 from spark_ensemble_tpu.utils.instrumentation import (
     Instrumentation,
@@ -158,8 +171,15 @@ class BoostingClassifier(_BoostingParams):
 
     @instrumented_fit
     def fit(
-        self, X, y, sample_weight=None, num_classes=None
+        self, X, y, sample_weight=None, num_classes=None, mesh=None
     ) -> "BoostingClassificationModel":
+        """Fit; with ``mesh`` (a "data" axis, optionally hybrid
+        ``("dcn_data", "data")``) every round runs as ONE shard_map-ed SPMD
+        program with rows sharded over "data": the normalized weight mass,
+        the weighted error, and the base fit's sufficient statistics all
+        reduce via psum — the XLA replacement for the reference's
+        executor-side ``treeAggregate`` round reductions
+        (`BoostingClassifier.scala:175,235-242`)."""
         X, y = as_f32(X), as_f32(y)
         w = resolve_weights(y, sample_weight)
         num_classes = infer_num_classes(y, num_classes)
@@ -175,12 +195,27 @@ class BoostingClassifier(_BoostingParams):
         k = num_classes
         root = jax.random.PRNGKey(self.seed)
 
+        # ---- mesh setup: pad rows (weight 0 -> statistics unchanged) and
+        # shard ctx/X/y/boosting-weights over the data axis ----
+        ax = None
+        n_pad = n
+        if mesh is not None:
+            ctx, X, ax, n_pad, (y, w) = setup_row_sharding(
+                mesh, base, ctx, X, n, (y, w)
+            )
+
         def build_step():
+            def gsum(v):
+                # global scalar reduction: the SPMD treeReduce
+                return preduce(v, ax)
+
             def round_discrete(ctx, X, y, bw, key):
-                w_norm = bw / jnp.maximum(jnp.sum(bw), 1e-30)
-                params = base.fit_from_ctx(ctx, y, w_norm, None, key)
+                w_norm = bw / jnp.maximum(gsum(jnp.sum(bw)), 1e-30)
+                params = base.fit_from_ctx(
+                    ctx, y, w_norm, None, key, axis_name=ax
+                )
                 miss = (base.predict_fn(params, X) != y).astype(jnp.float32)
-                err = jnp.sum(w_norm * miss)
+                err = gsum(jnp.sum(w_norm * miss))
                 beta = err / jnp.maximum((1.0 - err) * (k - 1.0), 1e-30)
                 est_weight = jnp.where(
                     beta == 0.0, 1.0, jnp.log(1.0 / jnp.maximum(beta, 1e-300))
@@ -189,13 +224,15 @@ class BoostingClassifier(_BoostingParams):
                 return params, err, est_weight, new_bw
 
             def round_real(ctx, X, y, bw, key):
-                w_norm = bw / jnp.maximum(jnp.sum(bw), 1e-30)
-                params = base.fit_from_ctx(ctx, y, w_norm, None, key)
+                w_norm = bw / jnp.maximum(gsum(jnp.sum(bw)), 1e-30)
+                params = base.fit_from_ctx(
+                    ctx, y, w_norm, None, key, axis_name=ax
+                )
                 proba = base.predict_proba_fn(params, X)  # [n, k]
                 miss = (jnp.argmax(proba, axis=-1) != y.astype(jnp.int32)).astype(
                     jnp.float32
                 )
-                err = jnp.sum(w_norm * miss)
+                err = gsum(jnp.sum(w_norm * miss))
                 codes = jnp.where(
                     jax.nn.one_hot(y.astype(jnp.int32), k) > 0, 1.0, -1.0 / (k - 1.0)
                 )
@@ -210,17 +247,36 @@ class BoostingClassifier(_BoostingParams):
                     params, err, est_weight, new_bw = round_core(
                         ctx, X, y, bw, key
                     )
-                    return new_bw, (params, err, est_weight, jnp.sum(new_bw))
+                    return new_bw, (
+                        params, err, est_weight, gsum(jnp.sum(new_bw))
+                    )
 
                 bw, (params_c, errs, est_ws, sum_bws) = jax.lax.scan(
                     body, bw, keys
                 )
                 return params_c, errs, est_ws, sum_bws, bw
 
-            return jax.jit(chunk)
+            if mesh is None:
+                return jax.jit(chunk)
+            return jax.jit(
+                shard_map(
+                    chunk,
+                    mesh=mesh,
+                    in_specs=(
+                        base.ctx_specs(ctx, ax),
+                        P(ax, None),  # X
+                        P(ax),  # y
+                        P(ax),  # bw
+                        P(),  # keys [c, 2]
+                    ),
+                    out_specs=(P(), P(), P(), P(), P(ax)),
+                    check_vma=False,
+                )
+            )
 
         chunk_step = cached_program(
-            ("boosting_cls_chunk", algorithm, k, base.config_key()), build_step
+            ("boosting_cls_chunk", algorithm, k, base.config_key(), mesh),
+            build_step,
         )
 
         def replay(errs, sum_bws, c, i):
@@ -253,12 +309,19 @@ class BoostingClassifier(_BoostingParams):
         members_chunks: List[Any] = []
         weights_chunks: List[Any] = []
         i = 0
-        ckpt = self._checkpointer(n, d, num_classes)
+        # n_pad is part of the resume identity: a checkpointed `bw` is padded
+        # to the mesh's data-axis size, so a resume under a different mesh
+        # must start fresh rather than load a wrong-length weight vector
+        ckpt = self._checkpointer(n, d, num_classes, n_pad)
         resumed = ckpt.load_latest()
         if resumed is not None:
             last_round, st = resumed
             i = last_round + 1
             bw = jnp.asarray(st["bw"])
+            if mesh is not None:
+                bw = jax.device_put(
+                    bw, NamedSharding(mesh, P(_mesh_row_spec(mesh)))
+                )
             members_chunks, weights_chunks = self._resume_chunks(
                 st, weights_key="est_weights"
             )
@@ -347,7 +410,14 @@ class BoostingRegressor(_BoostingParams):
         return self.base_learner or DecisionTreeRegressor()
 
     @instrumented_fit
-    def fit(self, X, y, sample_weight=None) -> "BoostingRegressionModel":
+    def fit(
+        self, X, y, sample_weight=None, mesh=None
+    ) -> "BoostingRegressionModel":
+        """Fit; with ``mesh`` rows shard over "data" and each Drucker round
+        reduces via collectives: weight mass and ``estErr`` psum, ``maxError``
+        pmax (the reference's distributed ``treeAggregate(max)``,
+        `BoostingRegressor.scala:232-249`).  Padding rows are excluded from
+        ``maxError`` by a validity mask (their weight is already 0)."""
         X, y = as_f32(X), as_f32(y)
         w = resolve_weights(y, sample_weight)
         n, d = X.shape
@@ -364,7 +434,22 @@ class BoostingRegressor(_BoostingParams):
         # run the wrong shaping under the original cache key
         loss_name = self.loss.lower()
 
+        # ---- mesh setup ----
+        ax = None
+        n_pad = n
+        valid = jnp.ones((n,), jnp.float32)
+        if mesh is not None:
+            ctx, X, ax, n_pad, (y, w, valid) = setup_row_sharding(
+                mesh, base, ctx, X, n, (y, w, valid)
+            )
+
         def build_step():
+            def gsum(v):
+                return preduce(v, ax)
+
+            def gmax(v):
+                return pmax_reduce(v, ax)
+
             def shape_loss(e):
                 if loss_name == "exponential":
                     return 1.0 - jnp.exp(-e)
@@ -372,16 +457,20 @@ class BoostingRegressor(_BoostingParams):
                     return e * e
                 return e
 
-            def step(ctx, X, y, bw, key):
-                w_norm = bw / jnp.maximum(jnp.sum(bw), 1e-30)
-                params = base.fit_from_ctx(ctx, y, w_norm, None, key)
-                errors = jnp.abs(y - base.predict_fn(params, X))
-                max_error = jnp.max(errors)
+            def step(ctx, X, y, valid, bw, key):
+                w_norm = bw / jnp.maximum(gsum(jnp.sum(bw)), 1e-30)
+                params = base.fit_from_ctx(
+                    ctx, y, w_norm, None, key, axis_name=ax
+                )
+                # mask padding rows out of the max: their |y - pred| is
+                # meaningless (y padded with 0) and must not set maxError
+                errors = valid * jnp.abs(y - base.predict_fn(params, X))
+                max_error = gmax(jnp.max(errors))
                 rel = jnp.where(
                     max_error > 0, errors / jnp.maximum(max_error, 1e-30), errors
                 )
                 losses = shape_loss(rel)
-                est_err = jnp.sum(w_norm * losses)
+                est_err = gsum(jnp.sum(w_norm * losses))
                 beta = est_err / jnp.maximum(1.0 - est_err, 1e-30)
                 est_weight = jnp.where(
                     beta == 0.0, 1.0, jnp.log(1.0 / jnp.maximum(beta, 1e-300))
@@ -390,13 +479,14 @@ class BoostingRegressor(_BoostingParams):
                 new_bw = jnp.where(beta == 0.0, jnp.zeros_like(new_bw), new_bw)
                 return params, max_error, est_err, est_weight, new_bw
 
-            def chunk(ctx, X, y, bw, keys):
+            def chunk(ctx, X, y, valid, bw, keys):
                 def body(bw, key):
                     params, max_error, est_err, est_weight, new_bw = step(
-                        ctx, X, y, bw, key
+                        ctx, X, y, valid, bw, key
                     )
                     return new_bw, (
-                        params, max_error, est_err, est_weight, jnp.sum(new_bw)
+                        params, max_error, est_err, est_weight,
+                        gsum(jnp.sum(new_bw)),
                     )
 
                 bw, (params_c, max_errs, est_errs, est_ws, sum_bws) = (
@@ -404,10 +494,28 @@ class BoostingRegressor(_BoostingParams):
                 )
                 return params_c, max_errs, est_errs, est_ws, sum_bws, bw
 
-            return jax.jit(chunk)
+            if mesh is None:
+                return jax.jit(chunk)
+            return jax.jit(
+                shard_map(
+                    chunk,
+                    mesh=mesh,
+                    in_specs=(
+                        base.ctx_specs(ctx, ax),
+                        P(ax, None),  # X
+                        P(ax),  # y
+                        P(ax),  # valid
+                        P(ax),  # bw
+                        P(),  # keys [c, 2]
+                    ),
+                    out_specs=(P(), P(), P(), P(), P(), P(ax)),
+                    check_vma=False,
+                )
+            )
 
         chunk_step = cached_program(
-            ("boosting_reg_chunk", loss_name, base.config_key()), build_step
+            ("boosting_reg_chunk", loss_name, base.config_key(), mesh),
+            build_step,
         )
 
         def replay(extras, sum_bws, c, i):
@@ -440,7 +548,7 @@ class BoostingRegressor(_BoostingParams):
 
         def run_chunk(keys, bw):
             params_c, max_errs, est_errs, est_ws, sum_bws, bw = chunk_step(
-                ctx, X, y, bw, keys
+                ctx, X, y, valid, bw, keys
             )
             return (
                 params_c,
@@ -454,12 +562,17 @@ class BoostingRegressor(_BoostingParams):
         members_chunks: List[Any] = []
         weights_chunks: List[Any] = []
         i = 0
-        ckpt = self._checkpointer(n, d)
+        # n_pad in the fingerprint: see BoostingClassifier.fit
+        ckpt = self._checkpointer(n, d, n_pad)
         resumed = ckpt.load_latest()
         if resumed is not None:
             last_round, st = resumed
             i = last_round + 1
             bw = jnp.asarray(st["bw"])
+            if mesh is not None:
+                bw = jax.device_put(
+                    bw, NamedSharding(mesh, P(_mesh_row_spec(mesh)))
+                )
             members_chunks, weights_chunks = self._resume_chunks(
                 st, weights_key="est_weights"
             )

@@ -114,6 +114,39 @@ def _shard_put(tree, specs, mesh: Mesh):
     return jax.device_put(tree, shardings)
 
 
+def shard_fit_rows(mesh: Mesh, base, ctx, X, n_pad: int):
+    """Pad the fit ctx and feature matrix to the data-axis size and
+    device_put them row-sharded (over "data", or ("dcn_data", "data")
+    on a hybrid multi-slice mesh).  Shared by the row-sharding estimators
+    (GBM and Boosting; see also ``setup_row_sharding``)."""
+    row_spec = _mesh_row_spec(mesh)
+    ctx_specs = base.ctx_specs(ctx, row_spec)
+    ctx = _shard_put(
+        _pad_ctx_rows(ctx, ctx_specs, n_pad, data_axis=row_spec),
+        ctx_specs,
+        mesh,
+    )
+    X = jax.device_put(
+        _pad_rows(X, n_pad), NamedSharding(mesh, P(row_spec, None))
+    )
+    return ctx, X
+
+
+def setup_row_sharding(mesh: Mesh, base, ctx, X, n: int, row_vectors=()):
+    """The full mesh row-sharding preamble shared by every row-sharding
+    estimator fit: resolve the row axis spec and padded length, pad+shard
+    the fit ctx and feature matrix, and pad+shard each 1-D per-row vector
+    (labels, weights, validity masks).  Returns
+    ``(ctx, X, ax, n_pad, sharded_vectors)``."""
+    data_size, _ = _mesh_sizes(mesh)
+    ax = _mesh_row_spec(mesh)
+    n_pad = n + (-n) % data_size
+    ctx, X = shard_fit_rows(mesh, base, ctx, X, n_pad)
+    row = NamedSharding(mesh, P(ax))
+    vecs = tuple(jax.device_put(_pad_rows(v, n_pad), row) for v in row_vectors)
+    return ctx, X, ax, n_pad, vecs
+
+
 def _mesh_row_axes(mesh: Mesh):
     """Mesh axes rows shard over: ("dcn_data", "data") on a multi-slice
     hybrid mesh (`parallel/mesh.py:hybrid_data_member_mesh`) — row
@@ -154,8 +187,6 @@ def concat_pytrees(chunks: List[Any]):
     return jax.tree_util.tree_map(
         lambda *xs: jnp.concatenate(xs, axis=0), *chunks
     )
-
-
 
 
 class _GBMParams(CheckpointableParams, Estimator):
@@ -313,23 +344,6 @@ class _GBMParams(CheckpointableParams, Estimator):
                 i += 1
         return i, v, best
 
-    @staticmethod
-    def _shard_fit_rows(mesh: Mesh, base: BaseLearner, ctx, X, n_pad: int):
-        """Pad the fit ctx and feature matrix to the data-axis size and
-        device_put them row-sharded (over "data", or ("dcn_data", "data")
-        on a hybrid multi-slice mesh)."""
-        row_spec = _mesh_row_spec(mesh)
-        ctx_specs = base.ctx_specs(ctx, row_spec)
-        ctx = _shard_put(
-            _pad_ctx_rows(ctx, ctx_specs, n_pad, data_axis=row_spec),
-            ctx_specs,
-            mesh,
-        )
-        X = jax.device_put(
-            _pad_rows(X, n_pad), NamedSharding(mesh, P(row_spec, None))
-        )
-        return ctx, X
-
 
 def _pseudo_residuals_and_weights(
     loss, updates, y_enc, pred, bag_w, w, axis_name=None
@@ -436,19 +450,11 @@ class GBMRegressor(_GBMParams):
         # ---- mesh setup: pad rows to the data-axis size, shard arrays ----
         ax = None
         n_pad = n
+        valid_w = jnp.ones((n,), jnp.float32)
         if mesh is not None:
-            data_size, _ = _mesh_sizes(mesh)
-            ax = _mesh_row_spec(mesh)
-            n_pad = n + (-n) % data_size
-            ctx, X = self._shard_fit_rows(mesh, base, ctx, X, n_pad)
-            row = NamedSharding(mesh, P(ax))
-            y = jax.device_put(_pad_rows(y, n_pad), row)
-            w = jax.device_put(_pad_rows(w, n_pad), row)
-            valid_w = jax.device_put(
-                _pad_rows(jnp.ones((n,), jnp.float32), n_pad), row
+            ctx, X, ax, n_pad, (y, w, valid_w) = setup_row_sharding(
+                mesh, base, ctx, X, n, (y, w, valid_w)
             )
-        else:
-            valid_w = jnp.ones((n,), jnp.float32)
         pred = init_model.predict(X)
 
         updates = self.updates.lower()
@@ -923,7 +929,7 @@ class GBMClassifier(_GBMParams):
 
         # ---- mesh: pad rows, shard row-indexed arrays over "data" --------
         if mesh is not None:
-            ctx, X = self._shard_fit_rows(mesh, base, ctx, X, n_pad)
+            ctx, X = shard_fit_rows(mesh, base, ctx, X, n_pad)
             y_enc = jax.device_put(
                 _pad_rows(y_enc, n_pad), NamedSharding(mesh, P(ax, None))
             )

@@ -17,3 +17,10 @@ import jax
 def preduce(x, axis_name: Optional[str]):
     """``psum`` over ``axis_name`` inside shard_map; identity when unsharded."""
     return jax.lax.psum(x, axis_name) if axis_name is not None else x
+
+
+def pmax_reduce(x, axis_name: Optional[str]):
+    """``pmax`` over ``axis_name`` inside shard_map; identity when unsharded
+    (Drucker boosting's distributed ``maxError``,
+    `BoostingRegressor.scala:232-249`)."""
+    return jax.lax.pmax(x, axis_name) if axis_name is not None else x

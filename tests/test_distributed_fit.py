@@ -231,3 +231,124 @@ def test_gbm_mesh_scan_chunk_invariance(mesh42):
         np.asarray(models[1].predict_raw(X[:200])),
         rtol=1e-5, atol=1e-5,
     )
+
+
+# ---------------------------------------------------------------------------
+# Boosting: the reference runs every boosting round distributed — weights as
+# an RDD, error reductions as treeAggregate (`BoostingClassifier.scala:
+# 175,235-242`, `BoostingRegressor.scala:232-249`).  Here fit(..., mesh=...)
+# shards rows + the boosting weight vector and psums/pmaxes the round
+# reductions; the host abort replay must then match the single-device run
+# round for round.
+# ---------------------------------------------------------------------------
+
+
+def test_boosting_regressor_mesh_pointwise_single_round(mesh8):
+    # n=700 not divisible by 8: exercises the zero-weight row padding AND the
+    # maxError validity mask (a padded row's |y - pred| must not set the max)
+    from spark_ensemble_tpu import BoostingRegressor
+
+    X, y = _reg_data()
+    cfg = dict(num_base_learners=1, loss="exponential", seed=7)
+    single = BoostingRegressor(**cfg).fit(X, y)
+    dist = BoostingRegressor(**cfg).fit(X, y, mesh=mesh8)
+    assert single.num_members == dist.num_members == 1
+    np.testing.assert_allclose(
+        np.asarray(single.predict(X)), np.asarray(dist.predict(X)),
+        rtol=1e-3, atol=1e-3,
+    )
+
+
+def test_boosting_regressor_mesh_metric_parity(mesh8):
+    from spark_ensemble_tpu import BoostingRegressor
+
+    X, y = _reg_data()
+    for loss in ("linear", "squared"):
+        cfg = dict(num_base_learners=6, loss=loss, seed=3)
+        single = BoostingRegressor(**cfg).fit(X, y)
+        dist = BoostingRegressor(**cfg).fit(X, y, mesh=mesh8)
+        # abort/stop replay must fire at the same round index
+        assert single.num_members == dist.num_members, loss
+        r_s, r_d = _rmse(single.predict(X), y), _rmse(dist.predict(X), y)
+        assert abs(r_s - r_d) < 0.03 * max(r_s, r_d) + 1e-6, (loss, r_s, r_d)
+
+
+def test_boosting_classifier_mesh_discrete_parity(mesh8):
+    from spark_ensemble_tpu import BoostingClassifier
+
+    X, y = _cls_data()
+    cfg = dict(num_base_learners=6, algorithm="discrete", seed=9)
+    single = BoostingClassifier(**cfg).fit(X, y)
+    dist = BoostingClassifier(**cfg).fit(X, y, mesh=mesh8)
+    assert single.num_members == dist.num_members
+    # discrete votes amplify single split flips (a psum-order ulp can move
+    # one threshold, changing that member's hard vote on nearby rows), so
+    # the bar is metric parity + strong-majority agreement, not pointwise
+    ps, pd = np.asarray(single.predict(X)), np.asarray(dist.predict(X))
+    assert np.mean(ps == pd) > 0.85
+    acc_s, acc_d = float(np.mean(ps == y)), float(np.mean(pd == y))
+    assert abs(acc_s - acc_d) < 0.03, (acc_s, acc_d)
+
+
+def test_boosting_classifier_mesh_real_parity(mesh8):
+    from spark_ensemble_tpu import BoostingClassifier
+
+    X, y = _cls_data()
+    cfg = dict(num_base_learners=5, algorithm="real", seed=9)
+    single = BoostingClassifier(**cfg).fit(X, y)
+    dist = BoostingClassifier(**cfg).fit(X, y, mesh=mesh8)
+    assert single.num_members == dist.num_members
+    # SAMME.R reweights by exp(log-prob sums), so one flipped split shifts
+    # every later round's weights — parity is metric-level (accuracy),
+    # exactly the tier Spark's own local-vs-cluster treeAggregate order gives
+    ps, pd = np.asarray(single.predict(X)), np.asarray(dist.predict(X))
+    assert np.mean(ps == pd) > 0.75
+    acc_s, acc_d = float(np.mean(ps == y)), float(np.mean(pd == y))
+    assert abs(acc_s - acc_d) < 0.03, (acc_s, acc_d)
+
+
+def test_boosting_regressor_mesh_abort_index(mesh8):
+    """Drucker's est_err >= 0.5 abort (`BoostingRegressor.scala:251`) must
+    fire at the SAME round distributed: outlier rows soak up boosting weight
+    until the psum-ed est_err crosses 0.5 strictly (round 3 with this seed;
+    SAMME's err >= 1-1/K is NOT used here because leaf-majority trees can
+    only ever TIE that threshold, which f32 reduction order could flip)."""
+    from spark_ensemble_tpu import BoostingRegressor
+    from spark_ensemble_tpu.models.tree import DecisionTreeRegressor
+
+    rng = np.random.RandomState(2)
+    n = 640
+    X = rng.randn(n, 4).astype(np.float32)
+    y = (2.0 * X[:, 0] + 0.1 * rng.randn(n)).astype(np.float32)
+    y = np.where(rng.rand(n) < 0.05, y + 50.0, y).astype(np.float32)
+    cfg = dict(
+        num_base_learners=10,
+        loss="squared",
+        base_learner=DecisionTreeRegressor(max_depth=3),
+        seed=1,
+    )
+    single = BoostingRegressor(**cfg).fit(X, y)
+    dist = BoostingRegressor(**cfg).fit(X, y, mesh=mesh8)
+    # the mid-run abort must actually trigger for this test to mean anything
+    assert 0 < single.num_members < 10
+    assert single.num_members == dist.num_members
+
+
+def test_boosting_mesh_scan_chunk_invariance(mesh8):
+    """Chunked SPMD dispatch == per-round dispatch on the same mesh
+    (identical psum points; only dispatch granularity differs)."""
+    from spark_ensemble_tpu import BoostingRegressor
+
+    X, y = _reg_data()
+    models = [
+        BoostingRegressor(
+            num_base_learners=5, loss="exponential", seed=4, scan_chunk=c
+        ).fit(X, y, mesh=mesh8)
+        for c in (1, 3)
+    ]
+    assert models[0].num_members == models[1].num_members
+    np.testing.assert_allclose(
+        np.asarray(models[0].predict(X[:200])),
+        np.asarray(models[1].predict(X[:200])),
+        rtol=1e-5, atol=1e-5,
+    )
