@@ -15,6 +15,13 @@ predictions, soft = summed member probabilities; probability = raw /
 numModels; prediction = argmax raw (Spark's raw2prediction path).
 BaggingRegressionModel predicts the unweighted mean
 (`BaggingRegressor.scala:221-228`).
+
+Distributed: ``fit(..., mesh=...)`` places the job on BOTH mesh axes —
+rows shard over "data" (the reference's row-partitioned RDDs,
+`BaggingRegressor.scala:149-150`; no device holds the full dataset) and
+members shard over "member" (the reference's driver thread-pool Futures).
+Each device fuse-fits its member block on its row shard with histograms
+psum-ed over "data", keeping the single-chip fit_forest fusion win.
 """
 
 from __future__ import annotations
@@ -70,56 +77,94 @@ class _BaggingParams(Estimator):
         return fit_w, masks, keys
 
     @staticmethod
-    def _shard_members(mesh: Mesh, ctx, y, fit_w, masks, keys):
-        """Shard the member axis over ALL mesh devices and replicate the
-        shared data — the TPU mapping of the reference's driver thread-pool
-        member parallelism (`BaggingClassifier.scala:180-201`,
-        `parallel/mesh.py` member axis).  The same vmapped fit program is
-        then auto-partitioned by XLA along the member axis, so every device
-        trains its own block of members and the fitted forest stays sharded
-        across devices.  A member count that does not divide the device
-        count is padded with zero-weight phantom members (trimmed by the
-        caller); phantom fits are all-zero-weight degenerate models that
-        cost one extra member slot per device at most."""
-        n_dev = mesh.devices.size
+    def _shard_rows_and_members(mesh: Mesh, base, ctx, y, fit_w, masks, keys):
+        """(data x member) placement — the TPU mapping of the reference's
+        TWO parallel axes at once: rows live partitioned across executors
+        (`BaggingRegressor.scala:149-150`) while members train concurrently
+        from the driver's thread pool (`BaggingClassifier.scala:180-201`).
+
+        Rows (the binning ctx, y, and fit_w's row dim) shard over "data"
+        (no device holds the full dataset — the scaling axis); members
+        (fit_w's member dim, masks, keys) shard over "member".  Each device
+        then fuse-fits its member block on its row shard, psum-ing
+        histograms over "data" (``fit_many_from_ctx(axis_name=...)``).
+
+        Member counts pad to the member-axis size with zero-weight phantom
+        members (trimmed by the caller); rows pad with zero-weight rows —
+        both leave every statistic unchanged.  On a data-only mesh (no
+        "member" axis) members replicate and only rows shard."""
+        from spark_ensemble_tpu.models.gbm import (
+            _mesh_row_spec,
+            _mesh_sizes,
+            _pad_rows,
+            shard_ctx_rows,
+        )
+
+        data_size, member_size = _mesh_sizes(mesh)
+        ax = _mesh_row_spec(mesh)
+        mem = "member" if "member" in mesh.axis_names else None
+        n = y.shape[0]
+        n_pad = n + (-n) % data_size
         m = fit_w.shape[0]
-        m_pad = m + (-m) % n_dev
+        m_pad = m + (-m) % member_size
         if m_pad != m:
             pad = [(0, m_pad - m)]
             fit_w = jnp.pad(fit_w, pad + [(0, 0)])
             masks = jnp.pad(masks, pad + [(0, 0)], constant_values=True)
             keys = jnp.pad(keys, pad + [(0, 0)] * (keys.ndim - 1))
-        member = NamedSharding(mesh, P(tuple(mesh.axis_names)))
-        rep = NamedSharding(mesh, P())
-        ctx = jax.device_put(ctx, jax.tree_util.tree_map(lambda _: rep, ctx))
-        y = jax.device_put(y, rep)
+        ctx, ctx_specs = shard_ctx_rows(mesh, base, ctx, n_pad)
+        fit_w = jnp.pad(fit_w, [(0, 0), (0, n_pad - n)])
         return (
             ctx,
-            y,
-            jax.device_put(fit_w, member),
-            jax.device_put(masks, member),
-            jax.device_put(keys, member),
+            ctx_specs,
+            ax,
+            mem,
+            jax.device_put(_pad_rows(y, n_pad), NamedSharding(mesh, P(ax))),
+            jax.device_put(fit_w, NamedSharding(mesh, P(mem, ax))),
+            jax.device_put(masks, NamedSharding(mesh, P(mem, None))),
+            jax.device_put(keys, NamedSharding(mesh, P(mem, None))),
         )
 
 
-def _build_fit_all(base: BaseLearner, sharded: bool):
-    """All-member fit program.  Single-device: the fused multi-member path
-    (``fit_many_from_ctx`` — trees fold the member axis into one histogram
-    matmul per level).  Mesh-sharded members: the vmapped per-member program,
-    which GSPMD partitions along the member axis across devices."""
-    if sharded:
-        return jax.jit(
-            lambda ctx, y, fit_w, masks, keys: jax.vmap(
-                lambda fw, m, k: base.fit_from_ctx(ctx, y, fw, m, k)
-            )(fit_w, masks, keys)
-        )
-    return jax.jit(
-        lambda ctx, y, fit_w, masks, keys: base.fit_many_from_ctx(
+def _fused_fit_block(base: BaseLearner, axis_name=None):
+    """The fused all-member fit body (`fit_many_from_ctx` — trees fold the
+    member axis into one histogram matmul per level)."""
+
+    def block(ctx, y, fit_w, masks, keys):
+        return base.fit_many_from_ctx(
             ctx,
             jnp.broadcast_to(y[:, None], (y.shape[0], fit_w.shape[0])),
             fit_w.T,
             masks,
             keys,
+            axis_name=axis_name,
+        )
+
+    return block
+
+
+def _build_fit_all(base: BaseLearner, mesh=None, ctx_specs=None, ax=None, mem=None):
+    """All-member fit program.  Single-device: the fused multi-member path.
+    Mesh: the SAME fused body shard_mapped over (data x member) — each
+    device fuse-fits its member block on its row shard with psum-ed
+    histograms, so the mesh path keeps the fit_forest fusion win."""
+    if mesh is None:
+        return jax.jit(_fused_fit_block(base))
+    from jax import shard_map
+
+    return jax.jit(
+        shard_map(
+            _fused_fit_block(base, axis_name=ax),
+            mesh=mesh,
+            in_specs=(
+                ctx_specs,
+                P(ax),  # y
+                P(mem, ax),  # fit_w
+                P(mem, None),  # masks
+                P(mem, None),  # keys
+            ),
+            out_specs=P(mem),
+            check_vma=False,
         )
     )
 
@@ -141,13 +186,16 @@ class BaggingRegressor(_BaggingParams):
         ctx = base.make_fit_ctx(X)
         fit_w, masks, keys = self._member_plan(n, d, w)
         member_masks = masks
+        ctx_specs = ax = mem = None
         if mesh is not None:
-            ctx, y, fit_w, masks, keys = self._shard_members(
-                mesh, ctx, y, fit_w, masks, keys
+            ctx, ctx_specs, ax, mem, y, fit_w, masks, keys = (
+                self._shard_rows_and_members(
+                    mesh, base, ctx, y, fit_w, masks, keys
+                )
             )
         fit_all = cached_program(
-            ("bagging_fit", base.config_key(), mesh is not None),
-            lambda: _build_fit_all(base, sharded=mesh is not None),
+            ("bagging_fit", base.config_key(), mesh),
+            lambda: _build_fit_all(base, mesh, ctx_specs, ax, mem),
         )
         members = fit_all(ctx, y, fit_w, masks, keys)
         members = jax.tree_util.tree_map(
@@ -194,13 +242,16 @@ class BaggingClassifier(_BaggingParams):
         ctx = base.make_fit_ctx(X, num_classes)
         fit_w, masks, keys = self._member_plan(n, d, w)
         member_masks = masks
+        ctx_specs = ax = mem = None
         if mesh is not None:
-            ctx, y, fit_w, masks, keys = self._shard_members(
-                mesh, ctx, y, fit_w, masks, keys
+            ctx, ctx_specs, ax, mem, y, fit_w, masks, keys = (
+                self._shard_rows_and_members(
+                    mesh, base, ctx, y, fit_w, masks, keys
+                )
             )
         fit_all = cached_program(
-            ("bagging_fit_cls", base.config_key(), num_classes, mesh is not None),
-            lambda: _build_fit_all(base, sharded=mesh is not None),
+            ("bagging_fit_cls", base.config_key(), num_classes, mesh),
+            lambda: _build_fit_all(base, mesh, ctx_specs, ax, mem),
         )
         members = fit_all(ctx, y, fit_w, masks, keys)
         members = jax.tree_util.tree_map(

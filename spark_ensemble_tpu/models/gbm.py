@@ -114,11 +114,11 @@ def _shard_put(tree, specs, mesh: Mesh):
     return jax.device_put(tree, shardings)
 
 
-def shard_fit_rows(mesh: Mesh, base, ctx, X, n_pad: int):
-    """Pad the fit ctx and feature matrix to the data-axis size and
-    device_put them row-sharded (over "data", or ("dcn_data", "data")
-    on a hybrid multi-slice mesh).  Shared by the row-sharding estimators
-    (GBM and Boosting; see also ``setup_row_sharding``)."""
+def shard_ctx_rows(mesh: Mesh, base, ctx, n_pad: int):
+    """Pad the fit ctx to the data-axis size and device_put it row-sharded
+    (over "data", or ("dcn_data", "data") on a hybrid multi-slice mesh).
+    Returns ``(ctx, ctx_specs)``.  Shared by every row-sharding estimator
+    (GBM, Boosting, Bagging)."""
     row_spec = _mesh_row_spec(mesh)
     ctx_specs = base.ctx_specs(ctx, row_spec)
     ctx = _shard_put(
@@ -126,8 +126,15 @@ def shard_fit_rows(mesh: Mesh, base, ctx, X, n_pad: int):
         ctx_specs,
         mesh,
     )
+    return ctx, ctx_specs
+
+
+def shard_fit_rows(mesh: Mesh, base, ctx, X, n_pad: int):
+    """``shard_ctx_rows`` plus the feature matrix (estimators whose round
+    step predicts on X: GBM, Boosting; see also ``setup_row_sharding``)."""
+    ctx, _ = shard_ctx_rows(mesh, base, ctx, n_pad)
     X = jax.device_put(
-        _pad_rows(X, n_pad), NamedSharding(mesh, P(row_spec, None))
+        _pad_rows(X, n_pad), NamedSharding(mesh, P(_mesh_row_spec(mesh), None))
     )
     return ctx, X
 

@@ -5,13 +5,15 @@ executor or many (`GBMClassifier.scala:344-355`,
 `BaggingClassifier.scala:180-201`).
 
 Parity tiers (mirroring what is provable in f32 SPMD):
-- **pointwise** for single-round GBM and for bagging (per-member math has no
-  cross-shard reduction): psum-ed statistics equal local sums to float noise;
-- **metric-level** for multi-round GBM: tree splits are argmaxes over psum-ed
-  histogram gains, so a last-ulp reduction-order difference can flip a split
-  and compound — exactly as Spark's own ``treeAggregate`` order differs
-  between local and cluster mode.  The fitted models must then agree as
-  *models* (RMSE / accuracy / agreement), not bit-for-bit.
+- **pointwise** for single-round GBM and single-round boosting: psum-ed
+  statistics equal local sums to float noise;
+- **metric-level** for multi-round GBM/boosting and for row-sharded bagging:
+  tree splits are argmaxes over psum-ed histogram gains, so a last-ulp
+  reduction-order difference can flip a split and compound (bagging: a
+  handful of rows near a flipped threshold move) — exactly as Spark's own
+  ``treeAggregate`` order differs between local and cluster mode.  The
+  fitted models must then agree as *models* (RMSE / accuracy / agreement),
+  not bit-for-bit.
 
 Runs on the 8-device virtual CPU mesh from conftest, the analogue of the
 reference's ``local[*]`` Spark sessions.
@@ -163,15 +165,53 @@ def test_gbm_classifier_mesh_validation_early_stop(mesh8):
 
 
 def test_bagging_regressor_mesh_parity(mesh42):
-    # no cross-shard reduction inside a member fit -> pointwise parity
+    # (data x member): rows 4-way, members 2-way.  Histogram sums now psum
+    # over "data", so parity is pointwise only up to reduction-order float
+    # noise (a near-tied split can flip — see module docstring)
     X, y = _reg_data()
     cfg = dict(num_base_learners=10, subsample_ratio=0.9, seed=11)
     single = BaggingRegressor(**cfg).fit(X, y)
     dist = BaggingRegressor(**cfg).fit(X, y, mesh=mesh42)
-    np.testing.assert_allclose(
+    r_s, r_d = _rmse(single.predict(X), y), _rmse(dist.predict(X), y)
+    assert abs(r_s - r_d) < 0.02 * max(r_s, r_d) + 1e-6, (r_s, r_d)
+    # all but the flip-affected handful of rows agree tightly
+    close = np.isclose(
         np.asarray(single.predict(X)), np.asarray(dist.predict(X)),
-        rtol=1e-5, atol=1e-5,
+        rtol=1e-3, atol=1e-3,
     )
+    assert np.mean(close) > 0.98, np.mean(close)
+
+
+def test_bagging_mesh_shards_rows_and_members(mesh42):
+    """The (data x member) placement contract, asserted structurally: the
+    fit ctx rows shard 4-way (no device holds the full dataset) and the
+    fitted members shard 2-way over "member"."""
+    from spark_ensemble_tpu.models.tree import DecisionTreeRegressor
+
+    X, y = _reg_data()
+    base = DecisionTreeRegressor()
+    ctx = base.make_fit_ctx(jax.numpy.asarray(X))
+    fit_w, masks, keys = BaggingRegressor(num_base_learners=10)._member_plan(
+        X.shape[0], X.shape[1], jax.numpy.ones(X.shape[0])
+    )
+    sh_ctx, _, _, _, sy, sfw, _, _ = BaggingRegressor._shard_rows_and_members(
+        mesh42, base, ctx, jax.numpy.asarray(y), fit_w, masks, keys
+    )
+    n_pad = sy.shape[0]
+    for leaf in jax.tree_util.tree_leaves(sh_ctx):
+        if leaf.ndim and leaf.shape[0] == n_pad:
+            local = leaf.sharding.shard_shape(leaf.shape)
+            assert local[0] == n_pad // 4, (leaf.shape, local)
+    # fit_w shards over (member, data): each device holds a [5, n/4] block
+    assert sfw.sharding.shard_shape(sfw.shape) == (
+        sfw.shape[0] // 2, n_pad // 4,
+    )
+    dist = BaggingRegressor(num_base_learners=10, seed=11).fit(
+        X, y, mesh=mesh42
+    )
+    leaf = jax.tree_util.tree_leaves(dist.params["members"])[0]
+    # members sharded over the "member" axis (2-way), replicated over "data"
+    assert leaf.sharding.shard_shape(leaf.shape)[0] * 2 >= leaf.shape[0]
 
 
 def test_bagging_classifier_mesh_parity(mesh8):
@@ -184,13 +224,11 @@ def test_bagging_classifier_mesh_parity(mesh8):
     )
     single = BaggingClassifier(**cfg).fit(X, y)
     dist = BaggingClassifier(**cfg).fit(X, y, mesh=mesh8)
-    np.testing.assert_allclose(
-        np.asarray(single.predict_raw(X)), np.asarray(dist.predict_raw(X)),
-        rtol=1e-5, atol=1e-5,
-    )
-    # fitted members actually live sharded across the mesh devices
-    leaf = jax.tree_util.tree_leaves(dist.params["members"])[0]
-    assert len(leaf.sharding.device_set) == 8
+    ps = np.asarray(single.predict(X))
+    pd = np.asarray(dist.predict(X))
+    assert np.mean(ps == pd) > 0.97
+    acc_s, acc_d = float(np.mean(ps == y)), float(np.mean(pd == y))
+    assert abs(acc_s - acc_d) < 0.02, (acc_s, acc_d)
 
 
 def test_gbm_hybrid_mesh_parity():
@@ -352,3 +390,18 @@ def test_boosting_mesh_scan_chunk_invariance(mesh8):
         np.asarray(models[1].predict(X[:200])),
         rtol=1e-5, atol=1e-5,
     )
+
+
+def test_bagging_data_only_mesh():
+    """A mesh with ONLY a "data" axis (no "member") row-shards the fit and
+    replicates members — the GBM-style data-parallel config must keep
+    working for bagging too."""
+    from spark_ensemble_tpu.parallel.mesh import create_mesh
+
+    X, y = _reg_data()
+    mesh = create_mesh({"data": 8})
+    cfg = dict(num_base_learners=5, subsample_ratio=0.9, seed=11)
+    single = BaggingRegressor(**cfg).fit(X, y)
+    dist = BaggingRegressor(**cfg).fit(X, y, mesh=mesh)
+    r_s, r_d = _rmse(single.predict(X), y), _rmse(dist.predict(X), y)
+    assert abs(r_s - r_d) < 0.02 * max(r_s, r_d) + 1e-6, (r_s, r_d)
