@@ -154,6 +154,25 @@ def setup_row_sharding(mesh: Mesh, base, ctx, X, n: int, row_vectors=()):
     return ctx, X, ax, n_pad, vecs
 
 
+def shard_validation_rows(mesh: Mesh, n_val: int, vectors=(), matrices=()):
+    """Pad+shard a validation split over the row axis for in-chunk SPMD
+    evaluation (shared by both GBM flavors).  Returns
+    ``(nv_pad, valid_mask, sharded_vectors, sharded_matrices)`` — the mask
+    is 1.0 on real rows, 0.0 on padding, so weighted val-loss means ignore
+    the padding."""
+    data_size, _ = _mesh_sizes(mesh)
+    ax = _mesh_row_spec(mesh)
+    nv_pad = n_val + (-n_val) % data_size
+    row = NamedSharding(mesh, P(ax))
+    row2 = NamedSharding(mesh, P(ax, None))
+    valid = jax.device_put(
+        _pad_rows(jnp.ones((n_val,), jnp.float32), nv_pad), row
+    )
+    vecs = tuple(jax.device_put(_pad_rows(v, nv_pad), row) for v in vectors)
+    mats = tuple(jax.device_put(_pad_rows(m, nv_pad), row2) for m in matrices)
+    return nv_pad, valid, vecs, mats
+
+
 def _mesh_row_axes(mesh: Mesh):
     """Mesh axes rows shard over: ("dcn_data", "data") on a multi-slice
     hybrid mesh (`parallel/mesh.py:hybrid_data_member_mesh`) — row
@@ -253,24 +272,12 @@ class _GBMParams(CheckpointableParams, Estimator):
             return best, v + 1
         return err, 0
 
-    def _make_bag_fn(self, n: int, n_pad: int):
-        """Per-round bag weights, drawn over the ORIGINAL n rows
-        (bit-identical to the single-device draw) then zero-padded to the
-        sharded length.  One copy shared by both GBM flavors so their
-        bagging draws can never silently diverge."""
-        repl, sub_ratio = bool(self.replacement), float(self.subsample_ratio)
-        return cached_program(
-            ("gbm_bag", n, n_pad, repl, sub_ratio),
-            lambda: jax.jit(
-                lambda key: _pad_rows(
-                    bootstrap_weights(key, n, repl, sub_ratio), n_pad
-                )
-            ),
-        )
-
     def _make_bag_many_fn(self, n: int, n_pad: int):
         """Vmapped bag draws for a chunk of rounds: [c, 2] keys -> [c, n_pad]
-        weights, bit-identical per round to ``_make_bag_fn``."""
+        weights, drawn over the ORIGINAL n rows (bit-identical to the
+        single-device draw) then zero-padded to the sharded length.  One
+        copy shared by both GBM flavors so their bagging draws can never
+        silently diverge."""
         repl, sub_ratio = bool(self.replacement), float(self.subsample_ratio)
         return cached_program(
             ("gbm_bag_many", n, n_pad, repl, sub_ratio),
@@ -285,70 +292,52 @@ class _GBMParams(CheckpointableParams, Estimator):
 
     def _drive_rounds(
         self,
-        use_chunks: bool,
         ckpt,
         members_chunks: List[Any],
         weights_chunks: List[Any],
         run_chunk,  # (sl: slice) -> (params [c,...], weights [c,...], errs|None)
-        run_round,  # (i: int) -> (params, weight, err|None)   [per-round path]
         save_state,  # (round_idx, v, best) -> None  (must self-gate)
         label: str,
         i: int,
         v: int,
         best: float,
     ):
-        """The shared round-loop driver: scan-chunked dispatch (single
-        program per `scan_chunk` rounds — also under a mesh when there is no
-        validation stop to evaluate), per-round dispatch otherwise; patience
+        """The shared round-loop driver: scan-chunked dispatch (one program
+        per `scan_chunk` rounds, single-chip AND under a mesh — validation
+        losses come back per round from inside the chunk); patience
         bookkeeping, mid-chunk stop accounting, and periodic state saves are
-        identical for both GBM flavors.  ``run_chunk``/``run_round`` own the
+        identical for both GBM flavors.  ``run_chunk`` owns the
         prediction-state updates (via closure); extra members computed past a
         mid-chunk validation stop are trimmed by the caller's final
         ``keep = i - v`` slice."""
         chunk = max(int(self.scan_chunk), 1)
         while i < self.num_base_learners and v < self.num_rounds:
-            if use_chunks:
-                c = min(chunk, self.num_base_learners - i)
-                if ckpt.enabled:
-                    # end the chunk exactly on the next save boundary: keeps
-                    # periodic saves firing at any resume offset, including a
-                    # resume under a CHANGED checkpoint_interval
-                    c = min(c, ckpt.rounds_until_save(i))
-                params_c, weights_c, errs = run_chunk(slice(i, i + c))
-                members_chunks.append(params_c)
-                weights_chunks.append(weights_c)
-                stopped = False
-                if errs is not None:
-                    for j, err in enumerate(np.asarray(errs)):
-                        best, v = self._patience_step(
-                            best, float(err), v, self.validation_tol
-                        )
-                        logger.info(
-                            "%s round %d: val_loss=%.6f patience=%d",
-                            label, i + j, float(err), v,
-                        )
-                        if v >= self.num_rounds:
-                            i += j + 1
-                            stopped = True
-                            break
-                if not stopped:
-                    i += c
-                    save_state(i - 1, v, best)
-            else:
-                params, weight, err = run_round(i)
-                if err is not None:
+            c = min(chunk, self.num_base_learners - i)
+            if ckpt.enabled:
+                # end the chunk exactly on the next save boundary: keeps
+                # periodic saves firing at any resume offset, including a
+                # resume under a CHANGED checkpoint_interval
+                c = min(c, ckpt.rounds_until_save(i))
+            params_c, weights_c, errs = run_chunk(slice(i, i + c))
+            members_chunks.append(params_c)
+            weights_chunks.append(weights_c)
+            stopped = False
+            if errs is not None:
+                for j, err in enumerate(np.asarray(errs)):
                     best, v = self._patience_step(
-                        best, err, v, self.validation_tol
+                        best, float(err), v, self.validation_tol
                     )
                     logger.info(
-                        "%s round %d: val_loss=%.6f patience=%d", label, i, err, v
+                        "%s round %d: val_loss=%.6f patience=%d",
+                        label, i + j, float(err), v,
                     )
-                members_chunks.append(
-                    jax.tree_util.tree_map(lambda x: x[None], params)
-                )
-                weights_chunks.append(weight[None])
-                save_state(i, v, best)
-                i += 1
+                    if v >= self.num_rounds:
+                        i += j + 1
+                        stopped = True
+                        break
+            if not stopped:
+                i += c
+                save_state(i - 1, v, best)
         return i, v, best
 
 
@@ -518,27 +507,6 @@ class GBMRegressor(_GBMParams):
 
             return round_core
 
-        def build_round_step():
-            return jax.jit(
-                shard_map(
-                    make_round_core(),
-                    mesh=mesh,
-                    in_specs=(
-                        base.ctx_specs(ctx, ax),
-                        P(ax, None),  # X
-                        P(ax),  # bag_w
-                        P(),  # key
-                        P(),  # mask
-                        P(ax),  # pred
-                        P(),  # delta
-                        P(ax),  # y
-                        P(ax),  # w
-                    ),
-                    out_specs=(P(), P(), P(ax)),
-                    check_vma=False,
-                )
-            )
-
         def build_chunk_step():
             """lax.scan of round_core over a chunk of rounds (one dispatch
             per chunk; huber's adaptive delta and the validation loss are
@@ -580,13 +548,17 @@ class GBMRegressor(_GBMParams):
         def build_chunk_step_mesh():
             """Scan-chunked rounds as ONE shard_map-ed SPMD program — the
             distributed path gets the same dispatch amortization as the
-            single-chip path (no validation state to evaluate per round on
-            this path; mesh+validation stays per-round)."""
+            single-chip path.  The validation split rides the same program:
+            X_val/pred_val shard over the row axis and each round's val loss
+            is a psum-ed weighted mean over the valid (non-padding) val rows
+            — the reference evaluates validation loss distributed per round
+            the same way (`GBMRegressor.scala:444-465`)."""
             round_core = make_round_core()
 
-            def chunk(ctx, X, y, w, valid_w, pred, delta, bag_ws, keys, masks):
+            def chunk(ctx, X, y, w, valid_w, pred, pred_val, delta,
+                      X_val_a, y_val_a, valid_val, bag_ws, keys, masks):
                 def body(carry, xs):
-                    pred, delta = carry
+                    pred, pred_val, delta = carry
                     bag_w, key, mask = xs
                     if huber:
                         # shard-local |residual| + all_gather inside the
@@ -598,12 +570,29 @@ class GBMRegressor(_GBMParams):
                     params, weight, new_pred = round_core(
                         ctx, X, bag_w, key, mask, pred, delta, y, w
                     )
-                    return (new_pred, delta), (params, weight)
+                    if with_validation:
+                        dir_val = base.predict_fn(params, X_val_a)
+                        new_pred_val = pred_val + weight * dir_val
+                        l = make_loss(delta)
+                        le = l.loss(
+                            l.encode_label(y_val_a), new_pred_val[:, None]
+                        )
+                        err = jax.lax.psum(
+                            jnp.sum(valid_val * jnp.reshape(le, (-1,))), ax
+                        ) / jax.lax.psum(jnp.sum(valid_val), ax)
+                    else:
+                        new_pred_val = pred_val
+                        err = jnp.float32(0)
+                    return (new_pred, new_pred_val, delta), (
+                        params, weight, err,
+                    )
 
-                (pred, delta), (params_all, weights_all) = jax.lax.scan(
-                    body, (pred, delta), (bag_ws, keys, masks)
+                (pred, pred_val, delta), (params_all, weights_all, errs) = (
+                    jax.lax.scan(
+                        body, (pred, pred_val, delta), (bag_ws, keys, masks)
+                    )
                 )
-                return params_all, weights_all, pred, delta
+                return params_all, weights_all, errs, pred, pred_val, delta
 
             return jax.jit(
                 shard_map(
@@ -616,12 +605,16 @@ class GBMRegressor(_GBMParams):
                         P(ax),  # w
                         P(ax),  # valid_w
                         P(ax),  # pred
+                        P(ax),  # pred_val
                         P(),  # delta
+                        P(ax, None),  # X_val
+                        P(ax),  # y_val
+                        P(ax),  # valid_val
                         P(None, ax),  # bag_ws [c, n_pad]
                         P(),  # keys [c, 2]
                         P(),  # masks [c, d]
                     ),
-                    out_specs=(P(), P(), P(ax), P()),
+                    out_specs=(P(), P(), P(), P(ax), P(ax), P()),
                     check_vma=False,
                 )
             )
@@ -640,21 +633,17 @@ class GBMRegressor(_GBMParams):
             base_key,
             mesh,
         )
-        use_chunks = mesh is None or not with_validation
-        if not use_chunks:
-            round_step = cached_program(round_key, build_round_step)
-            bag_fn = self._make_bag_fn(n, n_pad)
+        bag_many = self._make_bag_many_fn(n, n_pad)
+        if mesh is not None:
+            chunk_step = cached_program(
+                round_key + ("chunk_mesh", huber, with_validation),
+                build_chunk_step_mesh,
+            )
         else:
-            bag_many = self._make_bag_many_fn(n, n_pad)
-            if mesh is not None:
-                chunk_step = cached_program(
-                    round_key + ("chunk_mesh", huber), build_chunk_step_mesh
-                )
-            else:
-                chunk_step = cached_program(
-                    round_key + ("chunk", huber, with_validation),
-                    build_chunk_step,
-                )
+            chunk_step = cached_program(
+                round_key + ("chunk", huber, with_validation),
+                build_chunk_step,
+            )
 
         eval_loss = cached_program(
             ("gbm_reg_eval", loss_name, alpha_q),
@@ -667,38 +656,35 @@ class GBMRegressor(_GBMParams):
             ),
         )
 
-        huber_delta = cached_program(
-            ("gbm_reg_hdelta", alpha_q),
-            lambda: jax.jit(
-                lambda pred, y, vw: weighted_quantile(
-                    jnp.abs(y - pred), alpha_q, weights=vw
-                )
-            ),
-        )
-
-        predict_member = cached_program(
-            ("gbm_predict_member", base_key),
-            lambda: jax.jit(base.predict_fn),
-        )
-
         best = 0.0
         pred_val = None
-        val_dummy = jnp.zeros((0,), jnp.float32)
+        nv_pad = 0
+        valid_val = val_dummy = jnp.zeros((0,), jnp.float32)
+        val_dummy2 = jnp.zeros((0, 1), jnp.float32)
         if with_validation:
             X_val = jnp.asarray(X_val)
             y_val = jnp.asarray(y_val)
             pred_val = init_model.predict(X_val)
             best = float(eval_loss(pred_val, delta, y_val))
+            nv_pad = X_val.shape[0]
+            if mesh is not None:
+                # shard the validation split over the same row axis: its
+                # per-round loss is computed inside the chunked SPMD program
+                nv_pad, valid_val, (y_val, pred_val), (X_val,) = (
+                    shard_validation_rows(
+                        mesh, nv_pad, (y_val, pred_val), (X_val,)
+                    )
+                )
 
         members_chunks: List[Any] = []
         weights_chunks: List[Any] = []
         i, v = 0, 0
 
-        # n_pad is part of the identity: checkpointed `pred` is padded to
-        # the mesh's data-axis size, so a resume under a different mesh
-        # (different n_pad) must start fresh rather than load a wrong-length
-        # prediction state
-        ckpt = self._checkpointer(n, d, n_pad)
+        # n_pad AND nv_pad are part of the identity: checkpointed `pred` /
+        # `pred_val` are padded to the mesh's data-axis size, so a resume
+        # under a different mesh (different padding) must start fresh rather
+        # than load wrong-length prediction state
+        ckpt = self._checkpointer(n, d, n_pad, nv_pad)
         resumed = ckpt.load_latest()
         if resumed is not None:
             last_round, st = resumed
@@ -711,6 +697,10 @@ class GBMRegressor(_GBMParams):
             pred_val = st.get("pred_val")
             if pred_val is not None:
                 pred_val = jnp.asarray(pred_val)
+                if mesh is not None:
+                    pred_val = jax.device_put(
+                        pred_val, NamedSharding(mesh, P(_mesh_row_spec(mesh)))
+                    )
             members_chunks, weights_chunks = self._resume_chunks(st)
             delta = jnp.asarray(st["delta"])
             logger.info("GBMRegressor resuming from round %d", i)
@@ -736,41 +726,35 @@ class GBMRegressor(_GBMParams):
         def run_chunk(sl):
             nonlocal pred, pred_val, delta
             if mesh is not None:
-                params_c, weights_c, pred, delta = chunk_step(
-                    ctx, X, y, w, valid_w, pred, delta,
-                    bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
+                params_c, weights_c, errs, pred, pred_val_new, delta = (
+                    chunk_step(
+                        ctx, X, y, w, valid_w, pred,
+                        pred_val if with_validation else val_dummy,
+                        delta,
+                        X_val if with_validation else val_dummy2,
+                        y_val if with_validation else val_dummy,
+                        valid_val,
+                        bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
+                    )
                 )
-                return params_c, weights_c, None
-            params_c, weights_c, errs, pred, pred_val_new, delta = chunk_step(
-                ctx, X, y, w, valid_w, pred,
-                pred_val if with_validation else val_dummy,
-                delta,
-                X_val if with_validation else val_dummy,
-                y_val if with_validation else val_dummy,
-                bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
-            )
+            else:
+                params_c, weights_c, errs, pred, pred_val_new, delta = (
+                    chunk_step(
+                        ctx, X, y, w, valid_w, pred,
+                        pred_val if with_validation else val_dummy,
+                        delta,
+                        X_val if with_validation else val_dummy,
+                        y_val if with_validation else val_dummy,
+                        bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
+                    )
+                )
             if with_validation:
                 pred_val = pred_val_new
             return params_c, weights_c, errs if with_validation else None
 
-        def run_round(i):
-            nonlocal pred, pred_val, delta
-            if huber:
-                delta = huber_delta(pred, y, valid_w)
-            params, weight, pred = round_step(
-                ctx, X, bag_fn(bag_keys[i]), bag_keys[i], masks[i], pred,
-                delta, y, w,
-            )
-            err = None
-            if with_validation:
-                direction_val = predict_member(params, X_val)
-                pred_val = pred_val + weight * direction_val
-                err = float(eval_loss(pred_val, delta, y_val))
-            return params, weight, err
-
         i, v, best = self._drive_rounds(
-            use_chunks, ckpt, members_chunks, weights_chunks,
-            run_chunk, run_round, save_state, "GBMRegressor", i, v, best,
+            ckpt, members_chunks, weights_chunks,
+            run_chunk, save_state, "GBMRegressor", i, v, best,
         )
         ckpt.delete()
 
@@ -893,13 +877,16 @@ class GBMClassifier(_GBMParams):
         n_pad = n
         if mesh is not None:
             data_size, member_size = _mesh_sizes(mesh)
-            if dim % member_size != 0:
-                raise ValueError(
-                    f"class dim {dim} must be divisible by the 'member' mesh "
-                    f"axis size {member_size}"
-                )
             ax = _mesh_row_spec(mesh)
             n_pad = n + (-n) % data_size
+        # class dims round up to equal member-shard blocks; the tail block
+        # holds zero-weight phantom dims whose trees fit to all-zero labels
+        # (guarded leaf denominators -> 0-valued trees), are trimmed from
+        # the fitted params right after each chunk, and are sliced off the
+        # all_gather-ed directions BEFORE the loss/line-search ever sees
+        # them — any (K, member) combination works, like the reference's
+        # per-dim Futures (`GBMClassifier.scala:377-411`)
+        dim_blk = dim + (-dim) % member_size
 
         # init raw scores (`GBMClassifier.scala:275-288`); num_classes is
         # passed explicitly — the train split may be missing the top class
@@ -952,7 +939,7 @@ class GBMClassifier(_GBMParams):
             y_enc_val = loss.encode_label(y_val)
 
         def make_round_core():
-            k_local = dim // member_size
+            k_local = dim_blk // member_size
 
             def round_core(ctx, X, y_enc, w, bag_w, key, mask, pred):
                 labels, fit_w = _pseudo_residuals_and_weights(
@@ -960,7 +947,12 @@ class GBMClassifier(_GBMParams):
                 )
                 if member_size > 1:
                     # each member shard fits its block of class dims — the
-                    # SPMD replacement for the reference's per-dim Futures
+                    # SPMD replacement for the reference's per-dim Futures;
+                    # phantom tail dims carry zero labels AND zero weights
+                    if dim_blk != dim:
+                        pad = [(0, 0), (0, dim_blk - dim)]
+                        labels = jnp.pad(labels, pad)
+                        fit_w = jnp.pad(fit_w, pad)
                     sl = jax.lax.axis_index("member") * k_local
                     labels_blk = jax.lax.dynamic_slice_in_dim(
                         labels, sl, k_local, axis=1
@@ -980,7 +972,7 @@ class GBMClassifier(_GBMParams):
                 if member_size > 1:
                     directions = jax.lax.all_gather(
                         directions, "member", axis=1, tiled=True
-                    )
+                    )[:, :dim]
                 if optimized:
                     # SHARD-LOCAL objective; projected_newton_box psums
                     # value/grad/hessian over `ax` itself (psum inside the
@@ -1014,31 +1006,6 @@ class GBMClassifier(_GBMParams):
                 return params, weight, new_pred
 
             return round_core
-
-        def build_round_step():
-            round_core = make_round_core()
-            return jax.jit(
-                shard_map(
-                    round_core,
-                    mesh=mesh,
-                    in_specs=(
-                        base.ctx_specs(ctx, ax),
-                        P(ax, None),  # X
-                        P(ax, None),  # y_enc
-                        P(ax),  # w
-                        P(ax),  # bag_w
-                        P(),  # key
-                        P(),  # mask
-                        P(ax, None),  # pred
-                    ),
-                    out_specs=(
-                        P("member") if member_size > 1 else P(),
-                        P(),
-                        P(ax, None),
-                    ),
-                    check_vma=False,
-                )
-            )
 
         def build_chunk_step():
             """lax.scan of round_core over a chunk of rounds — ONE dispatch
@@ -1075,21 +1042,46 @@ class GBMClassifier(_GBMParams):
 
         def build_chunk_step_mesh():
             """Scan-chunked rounds as ONE shard_map-ed SPMD program (see
-            GBMRegressor.build_chunk_step_mesh)."""
+            GBMRegressor.build_chunk_step_mesh).  The validation split rides
+            the same program: per-round val losses are psum-ed weighted
+            means over valid (non-padding) val rows, with each member
+            shard's class-dim directions all_gather-ed before the update —
+            the reference's distributed per-round validation evaluation
+            (`GBMRegressor.scala:444-465`)."""
             round_core = make_round_core()
 
-            def chunk(ctx, X, y_enc, w, pred, bag_ws, keys, masks):
-                def body(pred, xs):
+            def chunk(ctx, X, y_enc, w, pred, pred_val, X_val_a,
+                      y_enc_val_a, valid_val, bag_ws, keys, masks):
+                def body(carry, xs):
+                    pred, pred_val = carry
                     bag_w, key, mask = xs
                     params, weight, new_pred = round_core(
                         ctx, X, y_enc, w, bag_w, key, mask, pred
                     )
-                    return new_pred, (params, weight)
+                    if with_validation:
+                        dirs_val = jax.vmap(
+                            lambda p: base.predict_fn(p, X_val_a)
+                        )(params).T
+                        if member_size > 1:
+                            dirs_val = jax.lax.all_gather(
+                                dirs_val, "member", axis=1, tiled=True
+                            )[:, :dim]
+                        new_pred_val = pred_val + weight[None, :] * dirs_val
+                        le = jnp.reshape(
+                            loss.loss(y_enc_val_a, new_pred_val), (-1,)
+                        )
+                        err = jax.lax.psum(jnp.sum(valid_val * le), ax) / (
+                            jax.lax.psum(jnp.sum(valid_val), ax)
+                        )
+                    else:
+                        new_pred_val = pred_val
+                        err = jnp.float32(0)
+                    return (new_pred, new_pred_val), (params, weight, err)
 
-                pred, (params_all, weights_all) = jax.lax.scan(
-                    body, pred, (bag_ws, keys, masks)
+                (pred, pred_val), (params_all, weights_all, errs) = (
+                    jax.lax.scan(body, (pred, pred_val), (bag_ws, keys, masks))
                 )
-                return params_all, weights_all, pred
+                return params_all, weights_all, errs, pred, pred_val
 
             return jax.jit(
                 shard_map(
@@ -1101,6 +1093,10 @@ class GBMClassifier(_GBMParams):
                         P(ax, None),  # y_enc
                         P(ax),  # w
                         P(ax, None),  # pred
+                        P(ax, None),  # pred_val
+                        P(ax, None),  # X_val
+                        P(ax, None),  # y_enc_val
+                        P(ax),  # valid_val
                         P(None, ax),  # bag_ws [c, n_pad]
                         P(),  # keys [c, 2]
                         P(),  # masks [c, d]
@@ -1108,6 +1104,8 @@ class GBMClassifier(_GBMParams):
                     out_specs=(
                         P(None, "member") if member_size > 1 else P(),
                         P(),
+                        P(),
+                        P(ax, None),
                         P(ax, None),
                     ),
                     check_vma=False,
@@ -1128,42 +1126,42 @@ class GBMClassifier(_GBMParams):
             base_key,
             mesh,
         )
-        use_chunks = mesh is None or not with_validation
-        if not use_chunks:
-            round_step = cached_program(round_key, build_round_step)
-            bag_fn = self._make_bag_fn(n, n_pad)
+        bag_many = self._make_bag_many_fn(n, n_pad)
+        if mesh is not None:
+            chunk_step = cached_program(
+                round_key + ("chunk_mesh", with_validation),
+                build_chunk_step_mesh,
+            )
         else:
-            bag_many = self._make_bag_many_fn(n, n_pad)
-            if mesh is not None:
-                chunk_step = cached_program(
-                    round_key + ("chunk_mesh",), build_chunk_step_mesh
-                )
-            else:
-                chunk_step = cached_program(
-                    round_key + ("chunk", with_validation), build_chunk_step
-                )
+            chunk_step = cached_program(
+                round_key + ("chunk", with_validation), build_chunk_step
+            )
 
         eval_loss = cached_program(
             ("gbm_cls_eval", loss_name, num_classes),
             lambda: jax.jit(lambda pred_v, y_enc_v: jnp.mean(loss.loss(y_enc_v, pred_v))),
         )
 
-        member_dirs = cached_program(
-            ("gbm_cls_member_dirs", base_key),
-            lambda: jax.jit(
-                lambda params, Xq: jax.vmap(lambda p: base.predict_fn(p, Xq))(params).T
-            ),
-        )
-
         best = 0.0
         pred_val = None
-        val_dummy = jnp.zeros((0,), jnp.float32)
+        nv_pad = 0
+        valid_val = val_dummy = jnp.zeros((0,), jnp.float32)
+        val_dummy2 = jnp.zeros((0, 1), jnp.float32)
         if with_validation:
             X_val = jnp.asarray(X_val)
             pred_val = jnp.broadcast_to(
                 init_raw[None, :], (X_val.shape[0], dim)
             ).astype(jnp.float32)
             best = float(eval_loss(pred_val, y_enc_val))
+            nv_pad = X_val.shape[0]
+            if mesh is not None:
+                # shard the validation split over the row axis (per-round
+                # losses come from inside the chunked SPMD program)
+                nv_pad, valid_val, _, (X_val, y_enc_val, pred_val) = (
+                    shard_validation_rows(
+                        mesh, nv_pad, (), (X_val, y_enc_val, pred_val)
+                    )
+                )
 
         # member params/weights accumulate as round-stacked chunks
         # (leading axis = rounds), concatenated once at the end
@@ -1171,9 +1169,9 @@ class GBMClassifier(_GBMParams):
         weights_chunks: List[Any] = []
         i, v = 0, 0
 
-        # n_pad in the identity: see GBMRegressor — padded `pred` must not
-        # be resumed under a mesh with a different data-axis size
-        ckpt = self._checkpointer(n, d, num_classes, n_pad)
+        # n_pad AND nv_pad in the identity: see GBMRegressor — padded
+        # `pred`/`pred_val` must not be resumed under a different topology
+        ckpt = self._checkpointer(n, d, num_classes, n_pad, nv_pad)
         resumed = ckpt.load_latest()
         if resumed is not None:
             last_round, st = resumed
@@ -1186,6 +1184,11 @@ class GBMClassifier(_GBMParams):
             pred_val = st.get("pred_val")
             if pred_val is not None:
                 pred_val = jnp.asarray(pred_val)
+                if mesh is not None:
+                    pred_val = jax.device_put(
+                        pred_val,
+                        NamedSharding(mesh, P(_mesh_row_spec(mesh), None)),
+                    )
             members_chunks, weights_chunks = self._resume_chunks(st)
             logger.info("GBMClassifier resuming from round %d", i)
 
@@ -1208,38 +1211,35 @@ class GBMClassifier(_GBMParams):
         def run_chunk(sl):
             nonlocal pred, pred_val
             if mesh is not None:
-                params_c, weights_c, pred = chunk_step(
+                params_c, weights_c, errs, pred, pred_val_new = chunk_step(
                     ctx, X, y_enc, w, pred,
+                    pred_val if with_validation else val_dummy2,
+                    X_val if with_validation else val_dummy2,
+                    y_enc_val if with_validation else val_dummy2,
+                    valid_val,
                     bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
                 )
-                return params_c, weights_c, None
-            params_c, weights_c, errs, pred, pred_val_new = chunk_step(
-                ctx, X, y_enc, w, pred,
-                pred_val if with_validation else val_dummy,
-                X_val if with_validation else val_dummy,
-                y_enc_val if with_validation else val_dummy,
-                bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
-            )
+                if dim_blk != dim:
+                    # drop the phantom tail trees: the fitted model's
+                    # [round, class-dim] grid must be exactly dim wide
+                    params_c = jax.tree_util.tree_map(
+                        lambda x: x[:, :dim], params_c
+                    )
+            else:
+                params_c, weights_c, errs, pred, pred_val_new = chunk_step(
+                    ctx, X, y_enc, w, pred,
+                    pred_val if with_validation else val_dummy,
+                    X_val if with_validation else val_dummy,
+                    y_enc_val if with_validation else val_dummy,
+                    bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
+                )
             if with_validation:
                 pred_val = pred_val_new
             return params_c, weights_c, errs if with_validation else None
 
-        def run_round(i):
-            nonlocal pred, pred_val
-            params, weight, pred = round_step(
-                ctx, X, y_enc, w, bag_fn(bag_keys[i]), bag_keys[i],
-                masks[i], pred,
-            )
-            err = None
-            if with_validation:
-                dirs_val = member_dirs(params, X_val)
-                pred_val = pred_val + weight[None, :] * dirs_val
-                err = float(eval_loss(pred_val, y_enc_val))
-            return params, weight, err
-
         i, v, best = self._drive_rounds(
-            use_chunks, ckpt, members_chunks, weights_chunks,
-            run_chunk, run_round, save_state, "GBMClassifier", i, v, best,
+            ckpt, members_chunks, weights_chunks,
+            run_chunk, save_state, "GBMClassifier", i, v, best,
         )
         ckpt.delete()
 

@@ -405,3 +405,96 @@ def test_bagging_data_only_mesh():
     dist = BaggingRegressor(**cfg).fit(X, y, mesh=mesh)
     r_s, r_d = _rmse(single.predict(X), y), _rmse(dist.predict(X), y)
     assert abs(r_s - r_d) < 0.02 * max(r_s, r_d) + 1e-6, (r_s, r_d)
+
+
+def test_gbm_mesh_validation_chunked_invariance(mesh8):
+    """mesh+validation now rides the chunked SPMD program (no per-round
+    dispatch path remains); the chunk size must not change the fitted model
+    — same psum points, same per-round val losses, same patience replay."""
+    X, y = _cls_data(n=900)
+    vi = np.zeros(900, bool)
+    vi[700:] = True
+    models = [
+        GBMClassifier(
+            num_base_learners=8, loss="logloss", num_rounds=2, seed=2,
+            scan_chunk=c,
+        ).fit(X, y, validation_indicator=vi, mesh=mesh8)
+        for c in (1, 3)
+    ]
+    assert models[0].num_members == models[1].num_members
+    np.testing.assert_allclose(
+        np.asarray(models[0].predict_raw(X[:100])),
+        np.asarray(models[1].predict_raw(X[:100])),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_gbm_regressor_mesh_validation_early_stop(mesh8):
+    """Regressor flavor of the chunked mesh+validation path, with huber's
+    in-chunk adaptive delta alongside the val-loss evaluation."""
+    X, y = _reg_data(n=900)
+    vi = np.zeros(900, bool)
+    vi[700:] = True
+    cfg = dict(
+        num_base_learners=8, loss="huber", alpha=0.9, num_rounds=2, seed=2
+    )
+    single = GBMRegressor(**cfg).fit(X, y, validation_indicator=vi)
+    dist = GBMRegressor(**cfg).fit(X, y, validation_indicator=vi, mesh=mesh8)
+    assert abs(single.num_members - dist.num_members) <= 1
+    r_s = _rmse(single.predict(X), y)
+    r_d = _rmse(dist.predict(X), y)
+    assert abs(r_s - r_d) < 0.05 * max(r_s, r_d) + 1e-6, (r_s, r_d)
+
+
+def test_gbm_classifier_mesh_indivisible_class_dim():
+    """K not divisible by the member axis: phantom class-dim trees pad the
+    member blocks (zero-weight fits, trimmed from the model), so ANY
+    (K, member) combination works — the reference's per-dim Futures have no
+    divisibility constraint either (`GBMClassifier.scala:377-411`)."""
+    X, y = _cls_data(k=5)  # dim 5, member 4 -> blocks of 2 with 3 phantoms
+    mesh = data_member_mesh(8, member=4)
+    cfg = dict(
+        num_base_learners=3, loss="logloss", updates="newton",
+        learning_rate=0.5, seed=5,
+    )
+    single = GBMClassifier(**cfg).fit(X, y)
+    dist = GBMClassifier(**cfg).fit(X, y, mesh=mesh)
+    assert np.asarray(dist.predict_raw(X[:8])).shape == (8, 5)
+    ps, pd = np.asarray(single.predict(X)), np.asarray(dist.predict(X))
+    assert np.mean(ps == pd) > 0.95
+    acc_s, acc_d = float(np.mean(ps == y)), float(np.mean(pd == y))
+    assert abs(acc_s - acc_d) < 0.03, (acc_s, acc_d)
+
+
+def test_gbm_mesh_validation_cross_topology_resume(mesh8, tmp_path):
+    """A single-chip checkpoint whose validation split does NOT divide the
+    mesh (nv=101, nv_pad would be 104) must not resume under the mesh —
+    the nv_pad fingerprint part forces a fresh start instead of feeding a
+    wrong-length pred_val into the SPMD program."""
+    from spark_ensemble_tpu.utils.checkpoint import TrainingCheckpointer
+
+    X, y = _cls_data(n=901)
+    vi = np.zeros(901, bool)
+    vi[800:] = True  # nv = 101
+    ckdir = str(tmp_path / "ck")
+    cfg = dict(num_base_learners=6, loss="logloss", num_rounds=3, seed=2,
+               checkpoint_dir=ckdir, checkpoint_interval=2, scan_chunk=2)
+    orig_delete = TrainingCheckpointer.delete
+    TrainingCheckpointer.delete = lambda self: None
+    try:
+        GBMClassifier(**dict(cfg, num_base_learners=4)).fit(
+            X, y, validation_indicator=vi
+        )
+    finally:
+        TrainingCheckpointer.delete = orig_delete
+    # mesh fit with the stale single-chip checkpoint present: fingerprint
+    # mismatch (nv_pad 101 vs 104) -> trains from scratch, no crash
+    m = GBMClassifier(**cfg).fit(X, y, validation_indicator=vi, mesh=mesh8)
+    s = GBMClassifier(**dict(cfg, checkpoint_dir=None)).fit(
+        X, y, validation_indicator=vi, mesh=mesh8
+    )
+    assert m.num_members == s.num_members
+    np.testing.assert_allclose(
+        np.asarray(m.predict_raw(X[:50])), np.asarray(s.predict_raw(X[:50])),
+        rtol=1e-5, atol=1e-5,
+    )
