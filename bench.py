@@ -30,7 +30,8 @@ _METRIC = "GBM boosting-iters/sec/chip (letter)"
 # First driver-captured iters/sec per device platform (see BASELINE.md).
 # vs_baseline for later rounds = measured / baseline on the same platform.
 _BASELINES = {
-    "cpu": None,  # filled from the first captured CPU number
+    # round 2 driver capture (BENCH_r02.json), letter 20 rounds on CPU
+    "cpu": 13.033,
     # round 2, TPU v5 lite, letter 100 rounds, newton+line-search
     # (BASELINE.md "Measured" table)
     "tpu": 6.991,
@@ -95,7 +96,7 @@ def _run_inner(env, timeout_s):
 
 def main():
     probe_timeout = _env_int("BENCH_PROBE_TIMEOUT", 240)
-    retries = _env_int("BENCH_PROBE_RETRIES", 2)
+    retries = _env_int("BENCH_PROBE_RETRIES", 4)
     inner_timeout = _env_int("BENCH_TIMEOUT", 3600)
 
     errors = []
@@ -106,7 +107,9 @@ def main():
             break
         errors.append(f"probe {attempt + 1}: {info}")
         if attempt + 1 < retries:
-            time.sleep(min(30 * (attempt + 1), 120))
+            # accelerator init hangs are server-side and can clear after
+            # minutes; back off harder before burning another probe
+            time.sleep(min(60 * (attempt + 1), 240))
 
     if ok:
         result, err = _run_inner(dict(os.environ), inner_timeout)
@@ -114,15 +117,31 @@ def main():
             errors.append(f"accelerator bench: {err}")
         else:
             result["value"] = result.get("value", 0.0)
+            if result.get("platform") not in (None, "cpu"):
+                # persist the perishable-window evidence: later CPU-fallback
+                # runs embed this capture under "last_tpu"
+                try:
+                    with open(
+                        os.path.join(_REPO, "BENCH_TPU_CAPTURE.json"), "w"
+                    ) as f:
+                        json.dump(result, f, indent=1)
+                except OSError:
+                    pass
             # a green accelerator run is not degraded: earlier probe
             # failures are warnings, not errors
             _finish(result, [], warnings=errors)
             return 0
 
-    # CPU fallback: fewer rounds (same metric — iters/sec), error carried
+    # CPU fallback: fewer rounds (same metric — iters/sec), error carried.
+    # The latest committed real-chip capture (BENCH_TPU_CAPTURE.json, written
+    # the moment a TPU window opens) rides along under "last_tpu" so the
+    # driver-recorded JSON always carries real-chip evidence.
     env = _cpu_env()
     env.setdefault("BENCH_ROUNDS", os.environ.get("BENCH_ROUNDS_CPU", "20"))
     result, err = _run_inner(env, inner_timeout)
+    last_tpu = _load_last_tpu_capture()
+    if result is not None and last_tpu is not None:
+        result["last_tpu"] = last_tpu
     if result is None:
         errors.append(f"cpu fallback: {err}")
         _finish(
@@ -137,6 +156,16 @@ def main():
         return 1
     _finish(result, errors)
     return 0
+
+
+def _load_last_tpu_capture():
+    """The committed real-chip capture, if any (see CPU-fallback note)."""
+    path = os.path.join(_REPO, "BENCH_TPU_CAPTURE.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
 
 
 def _finish(result, errors, warnings=None):
@@ -276,13 +305,18 @@ def _bench_large_extras():
         jax.block_until_ready(jax.tree_util.tree_leaves(model.params))
         fit_s = _time.perf_counter() - t0
         flops = _flops_per_round(n, d, k, 5, 64)
-        peak = _peak_flops(jax.devices()[0].platform)
-        return {
+        platform = jax.devices()[0].platform
+        out = {
             "large_iters_per_sec": round(rounds / fit_s, 3),
             "large_fit_seconds": round(fit_s, 2),
             "large_config": f"synthetic n={n} d={d} k={k} rounds={rounds}",
-            "large_mfu_est": round(flops * (rounds / fit_s) / peak, 5),
         }
+        if platform != "cpu":
+            # see inner(): MFU is only reported against a real chip's peak
+            out["large_mfu_est"] = round(
+                flops * (rounds / fit_s) / _peak_flops(platform), 5
+            )
+        return out
     except Exception as e:  # noqa: BLE001 - carry the error, keep going
         return {"large_error": str(e)[:200]}
 
@@ -341,28 +375,25 @@ def inner():
 
     flops = _flops_per_round(X.shape[0], X.shape[1], 26, 5, 64)
     platform = jax.devices()[0].platform
-    peak = _peak_flops(platform)
-    mfu = flops * iters_per_sec / peak
-
-    print(
-        json.dumps(
-            {
-                "metric": _METRIC,
-                "value": round(iters_per_sec, 3),
-                "unit": "iters/sec",
-                "vs_baseline": 1.0,
-                "predict_rows_per_sec": round(rows_per_sec, 1),
-                "fit_seconds": round(fit_s, 2),
-                "train_accuracy": round(train_acc, 4),
-                "num_rounds": num_rounds,
-                "flops_per_round_est": flops,
-                "mfu_est": round(mfu, 5),
-                "platform": platform,
-                "device": str(jax.devices()[0]),
-                **extras,
-            }
-        )
-    )
+    out = {
+        "metric": _METRIC,
+        "value": round(iters_per_sec, 3),
+        "unit": "iters/sec",
+        "vs_baseline": 1.0,
+        "predict_rows_per_sec": round(rows_per_sec, 1),
+        "fit_seconds": round(fit_s, 2),
+        "train_accuracy": round(train_acc, 4),
+        "num_rounds": num_rounds,
+        "flops_per_round_est": flops,
+        "platform": platform,
+        "device": str(jax.devices()[0]),
+        **extras,
+    }
+    if platform != "cpu":
+        # only meaningful against a real accelerator peak; a CPU "MFU"
+        # against an invented 1 TFLOP/s nominal is noise, not evidence
+        out["mfu_est"] = round(flops * iters_per_sec / _peak_flops(platform), 5)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -91,7 +91,13 @@ def cached_program(key: tuple, build: Callable[[], Callable]) -> Callable:
     only on information captured in ``key`` (plus argument shapes/dtypes,
     which jax.jit handles itself).  Thread-safe: concurrent member fits
     (stacking's driver-Future analogue) may race on the cache.
+
+    The default backend is appended to every key: some builders branch on
+    ``jax.default_backend()`` at trace time (e.g. fused-vs-vmapped
+    ``predict_forest``), so a process that switches backends between fits
+    must not reuse a program traced for the other backend.
     """
+    key = key + (jax.default_backend(),)
     with _PROGRAM_CACHE_LOCK:
         fn = _PROGRAM_CACHE.get(key)
         if fn is not None:
@@ -178,14 +184,17 @@ class Model(Params):
 
     def _cached_jit(self, name: str, builder):
         """Per-instance jit cache: model predict paths are built once and
-        reused across calls (a fresh vmap/jit per call would retrace)."""
+        reused across calls (a fresh vmap/jit per call would retrace).
+        Keyed by backend too — predict builders may branch on
+        ``jax.default_backend()`` at trace time (see ``cached_program``)."""
         cache = getattr(self, "_jit_cache", None)
         if cache is None:
             cache = {}
             object.__setattr__(self, "_jit_cache", cache)
-        if name not in cache:
-            cache[name] = jax.jit(builder)
-        return cache[name]
+        key = (name, jax.default_backend())
+        if key not in cache:
+            cache[key] = jax.jit(builder)
+        return cache[key]
 
     def __getstate__(self):
         state = dict(self.__dict__)
@@ -243,13 +252,28 @@ class CheckpointableParams(Params):
             p.pop(k, None)
         return p
 
+    # written into every checkpoint state so the members layout is explicit
+    # (a base learner whose params pytree is a top-level Python list must
+    # not be mistaken for the legacy per-round-list layout)
+    MEMBERS_LAYOUT = "stacked"
+
     @staticmethod
     def _resume_chunks(st, weights_key: str = "weights"):
         """Checkpointed members/weights -> round-stacked chunk lists.
-        Handles both the stacked layout (current) and the legacy
-        per-round-list layout."""
+        Branches on the explicit ``members_layout`` marker; checkpoints
+        without one (pre-marker) fall back to container-type sniffing for
+        the legacy per-round-list layout."""
         st_members, st_weights = st["members"], st[weights_key]
-        if isinstance(st_members, list):
+        layout = st.get("members_layout")
+        if layout is not None and layout != CheckpointableParams.MEMBERS_LAYOUT:
+            # fail fast: decoding an unknown layout as legacy would garble
+            # the resume far from the cause
+            raise ValueError(
+                f"unrecognized checkpoint members_layout {layout!r}; "
+                f"expected {CheckpointableParams.MEMBERS_LAYOUT!r}"
+            )
+        legacy = layout is None and isinstance(st_members, list)
+        if legacy:
             return (
                 [
                     jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], m)

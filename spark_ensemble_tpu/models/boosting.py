@@ -154,6 +154,7 @@ class _BoostingParams(CheckpointableParams, Estimator):
                     i - 1,
                     {
                         "bw": bw,
+                        "members_layout": self.MEMBERS_LAYOUT,
                         "members": concat_pytrees(members_chunks),
                         "est_weights": concat_pytrees(weights_chunks),
                     },

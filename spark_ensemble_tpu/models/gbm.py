@@ -717,6 +717,7 @@ class GBMRegressor(_GBMParams):
                     "best": best,
                     "pred": pred,
                     "pred_val": pred_val,
+                    "members_layout": self.MEMBERS_LAYOUT,
                     "members": concat_pytrees(members_chunks),
                     "weights": concat_pytrees(weights_chunks),
                     "delta": delta,
@@ -1203,6 +1204,7 @@ class GBMClassifier(_GBMParams):
                     "best": best,
                     "pred": pred,
                     "pred_val": pred_val,
+                    "members_layout": self.MEMBERS_LAYOUT,
                     "members": concat_pytrees(members_chunks),
                     "weights": concat_pytrees(weights_chunks),
                 },

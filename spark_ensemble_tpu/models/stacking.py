@@ -63,28 +63,68 @@ class _StackingParams(Estimator):
     )
     seed = Param(0)
 
-    def _fit_bases(self, bases, X, y, w, sample_weight, num_classes=None):
+    def _fit_bases(
+        self, bases, X, y, w, sample_weight, num_classes=None, mesh=None
+    ):
         """Fit the heterogeneous base learners, concurrently when
-        ``parallelism > 1`` (order-preserving)."""
+        ``parallelism > 1`` (order-preserving).
 
-        def fit_one(base):
+        With ``mesh``, member fits round-robin across the mesh's devices
+        (member i on device i mod n): each fit's arrays land and its
+        programs execute on its own chip, so heterogeneous members train
+        simultaneously on different devices — the TPU mapping of the
+        reference scheduling member fits as concurrent cluster jobs from
+        driver Futures (`StackingClassifier.scala:174-186`).  Combine with
+        ``parallelism > 1`` so dispatch threads overlap the per-device
+        executions; without it devices still pipeline dispatch-by-dispatch.
+        """
+        # only THIS process's devices are bindable via jax.default_device;
+        # on a multi-host pod each host round-robins over its own slice of
+        # the mesh (the fits themselves are single-device programs)
+        devices = (
+            [
+                d
+                for d in mesh.devices.flat
+                if d.process_index == jax.process_index()
+            ]
+            if mesh is not None
+            else [None]
+        ) or [None]
+
+        def fit_one(base_dev):
+            base, device = base_dev
             sw = w if base.supports_weight else None
             if not base.supports_weight and sample_weight is not None:
                 logger.warning(
                     "base learner %s does not support weights; ignoring",
                     type(base).__name__,
                 )
-            if num_classes is not None and base.is_classifier:
-                return base.fit(X, y, sample_weight=sw, num_classes=num_classes)
-            return base.fit(X, y, sample_weight=sw)
 
+            def run():
+                if num_classes is not None and base.is_classifier:
+                    return base.fit(
+                        X, y, sample_weight=sw, num_classes=num_classes
+                    )
+                return base.fit(X, y, sample_weight=sw)
+
+            if device is None:
+                return run()
+            # jax.default_device is thread-local: every array this fit
+            # creates (and thus every program it dispatches) binds to this
+            # member's device
+            with jax.default_device(device):
+                return run()
+
+        jobs = [
+            (b, devices[i % len(devices)]) for i, b in enumerate(bases)
+        ]
         par = int(self.parallelism or 1)
         if par > 1 and len(bases) > 1:
             from concurrent.futures import ThreadPoolExecutor
 
             with ThreadPoolExecutor(max_workers=min(par, len(bases))) as ex:
-                return list(ex.map(fit_one, bases))
-        return [fit_one(b) for b in bases]
+                return list(ex.map(fit_one, jobs))
+        return [fit_one(j) for j in jobs]
 
 
 class StackingRegressor(_StackingParams):
@@ -97,10 +137,12 @@ class StackingRegressor(_StackingParams):
         return self.stacker or LinearRegression()
 
     @instrumented_fit
-    def fit(self, X, y, sample_weight=None) -> "StackingRegressionModel":
+    def fit(self, X, y, sample_weight=None, mesh=None) -> "StackingRegressionModel":
+        """Fit; with ``mesh`` heterogeneous member fits are placed
+        round-robin on the mesh's devices (see ``_fit_bases``)."""
         X, y = as_f32(X), as_f32(y)
         w = resolve_weights(y, sample_weight)
-        models = self._fit_bases(self._bases(), X, y, w, sample_weight)
+        models = self._fit_bases(self._bases(), X, y, w, sample_weight, mesh=mesh)
         meta = jnp.stack([m.predict(X) for m in models], axis=1)  # [n, num_bases]
         stack_model = self._stacker().fit(meta, y, sample_weight=w)
         return StackingRegressionModel(
@@ -150,13 +192,16 @@ class StackingClassifier(_StackingParams):
 
     @instrumented_fit
     def fit(
-        self, X, y, sample_weight=None, num_classes=None
+        self, X, y, sample_weight=None, num_classes=None, mesh=None
     ) -> "StackingClassificationModel":
+        """Fit; with ``mesh`` heterogeneous member fits are placed
+        round-robin on the mesh's devices (see ``_fit_bases``)."""
         X, y = as_f32(X), as_f32(y)
         w = resolve_weights(y, sample_weight)
         num_classes = infer_num_classes(y, num_classes)
         models = self._fit_bases(
-            self._bases(), X, y, w, sample_weight, num_classes=num_classes
+            self._bases(), X, y, w, sample_weight, num_classes=num_classes,
+            mesh=mesh,
         )
         meta = self._meta_features(models, X)
         stacker = self._stacker()

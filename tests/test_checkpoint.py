@@ -1,6 +1,11 @@
 """Training-state checkpoint/resume tests (SURVEY.md §5: the TPU build gets
 real mid-run resumability where the reference only truncated RDD lineage)."""
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow
+
+
 import numpy as np
 
 import spark_ensemble_tpu as se

@@ -109,3 +109,51 @@ def test_graft_entry_dryrun():
     out = jax.jit(fn)(*args)
     assert out.shape[0] == args[0].shape[0]
     ge.dryrun_multichip(min(8, len(jax.devices())))
+
+
+class _FakeSliceDev:
+    """A fake multi-slice TPU device: just enough surface (slice_index,
+    coords, core_on_chip) for mesh_utils.create_hybrid_device_mesh to do
+    DCN-aware placement.  Each 4-device slice is a 2x2x1 torus."""
+
+    platform = "tpu"
+    device_kind = "fake-v5e"
+
+    def __init__(self, i, slice_index):
+        self.id = i
+        self.process_index = slice_index
+        self.slice_index = slice_index
+        j = i % 4
+        self.coords = (j % 2, j // 2, 0)
+        self.core_on_chip = 0
+
+    def __repr__(self):
+        return f"FakeDev({self.id},slice={self.slice_index})"
+
+
+def test_hybrid_mesh_dcn_branch_places_slices_on_dcn_axis():
+    """The DCN-aware branch (devices WITH slice_index) must run the real
+    mesh_utils.create_hybrid_device_mesh call and put each slice at one
+    dcn_data index — cross-slice traffic rides ONLY the dcn_data axis."""
+    from spark_ensemble_tpu.parallel.mesh import hybrid_data_member_mesh
+
+    devs = [_FakeSliceDev(i, i // 4) for i in range(8)]
+    mesh = hybrid_data_member_mesh(dcn_data=2, member=2, devices=devs)
+    assert dict(mesh.shape) == {"dcn_data": 2, "data": 2, "member": 2}
+    arr = mesh.devices
+    for a in range(2):
+        slices = {d.slice_index for d in arr[a].flat}
+        assert slices == {a}, (a, slices)
+
+
+def test_hybrid_mesh_dcn_branch_config_errors_propagate():
+    """dcn_data that contradicts the actual slice count must raise (the
+    plain-reshape fallback would silently shard across slice boundaries —
+    exactly what the DCN branch exists to prevent)."""
+    import pytest
+
+    from spark_ensemble_tpu.parallel.mesh import hybrid_data_member_mesh
+
+    devs = [_FakeSliceDev(i, i // 4) for i in range(8)]  # 2 slices
+    with pytest.raises(ValueError, match="slices"):
+        hybrid_data_member_mesh(dcn_data=4, member=2, devices=devs)

@@ -1,6 +1,11 @@
 """CrossValidator / TrainValidationSplit / Pipeline behavior (the model-
 selection composition the reference gets from Spark, `docs/example.md`)."""
 
+import pytest as _pytest
+
+pytestmark = _pytest.mark.slow
+
+
 import numpy as np
 import pytest
 

@@ -149,3 +149,32 @@ def test_feature_metadata_propagates_through_subspaces(tmp_path):
 
     with pytest.raises(ValueError, match="feature_names"):
         _ = se.DecisionTreeRegressor(feature_names=["x"]).fit(X, y).feature_metadata
+
+
+def test_logistic_no_intercept_scores_through_origin():
+    """fit_intercept=False pins the intercept to zero DURING optimization
+    (scale-only standardization — centering would smuggle an implicit
+    intercept back in).  Zero input must then score exactly zero raw margin
+    difference between symmetric points, and the model must still separate
+    data whose boundary passes through the origin."""
+    import numpy as np
+
+    from spark_ensemble_tpu.models.linear import LogisticRegression
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(600, 4).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)  # boundary at 0
+    for solver in ("newton", "lbfgs"):
+        m = LogisticRegression(fit_intercept=False, solver=solver).fit(X, y)
+        assert float(np.asarray(m.params["intercept"]).max()) == 0.0
+        assert float(np.asarray(m.params["intercept"]).min()) == 0.0
+        # raw scores are odd under x -> -x when there is no intercept
+        raw_p = np.asarray(m.predict_raw(X[:50]))
+        raw_n = np.asarray(m.predict_raw(-X[:50]))
+        np.testing.assert_allclose(
+            raw_p - raw_p.mean(axis=1, keepdims=True),
+            -(raw_n - raw_n.mean(axis=1, keepdims=True)),
+            rtol=1e-4, atol=1e-4,
+        )
+        acc = float(np.mean(np.asarray(m.predict(X)) == y))
+        assert acc > 0.95, (solver, acc)
