@@ -99,6 +99,17 @@ def main():
     retries = _env_int("BENCH_PROBE_RETRIES", 4)
     inner_timeout = _env_int("BENCH_TIMEOUT", 3600)
 
+    hp = os.environ.get("BENCH_HIST_PRECISION", "highest")
+    if hp not in ("highest", "high", "default"):
+        # reject up front: a typo'd knob must not burn both bounded
+        # subprocess runs before surfacing
+        print(json.dumps({
+            "metric": _METRIC, "value": 0.0, "unit": "iters/sec",
+            "vs_baseline": 0.0,
+            "error": f"BENCH_HIST_PRECISION must be highest|high|default, got {hp!r}",
+        }))
+        return 1
+
     errors = []
     ok = False
     for attempt in range(retries):
@@ -117,9 +128,13 @@ def main():
             errors.append(f"accelerator bench: {err}")
         else:
             result["value"] = result.get("value", 0.0)
+            # a green accelerator run is not degraded: earlier probe
+            # failures are warnings, not errors
+            _finish(result, [], warnings=errors)
             if result.get("platform") not in (None, "cpu"):
-                # persist the perishable-window evidence: later CPU-fallback
-                # runs embed this capture under "last_tpu"
+                # persist the perishable-window evidence AFTER _finish so
+                # the capture carries vs_baseline; later CPU-fallback runs
+                # embed it under "last_tpu"
                 try:
                     with open(
                         os.path.join(_REPO, "BENCH_TPU_CAPTURE.json"), "w"
@@ -127,9 +142,6 @@ def main():
                         json.dump(result, f, indent=1)
                 except OSError:
                     pass
-            # a green accelerator run is not degraded: earlier probe
-            # failures are warnings, not errors
-            _finish(result, [], warnings=errors)
             return 0
 
     # CPU fallback: fewer rounds (same metric — iters/sec), error carried.
@@ -335,6 +347,11 @@ def inner():
 
     X, y = _load_letter()
     num_rounds = _env_int("BENCH_ROUNDS", 100)
+    # BENCH_HIST_PRECISION=high|default compares the statistic-matmul MXU
+    # tiers (ops/tree.py hist_precision) against the exact-f32 default
+    hist_precision = os.environ.get("BENCH_HIST_PRECISION", "highest")
+
+    from spark_ensemble_tpu import DecisionTreeRegressor
 
     est = GBMClassifier(
         num_base_learners=num_rounds,
@@ -342,6 +359,7 @@ def inner():
         updates="newton",
         learning_rate=0.3,
         optimized_weights=True,
+        base_learner=DecisionTreeRegressor(hist_precision=hist_precision),
     )
 
     # warmup with the SAME config and round count: the scan-chunked loop
@@ -385,6 +403,7 @@ def inner():
         "train_accuracy": round(train_acc, 4),
         "num_rounds": num_rounds,
         "flops_per_round_est": flops,
+        "hist_precision": hist_precision,
         "platform": platform,
         "device": str(jax.devices()[0]),
         **extras,

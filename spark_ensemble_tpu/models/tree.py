@@ -30,13 +30,22 @@ from spark_ensemble_tpu.ops.tree import (
     predict_forest,
     predict_tree,
 )
-from spark_ensemble_tpu.params import Param, gt_eq, in_range
+from spark_ensemble_tpu.params import Param, gt_eq, in_array, in_range
 
 
 class _TreeLearner(BaseLearner):
     max_depth = Param(5, in_range(1, 20))
     max_bins = Param(64, gt_eq(2))
     min_info_gain = Param(0.0, gt_eq(0.0))
+    hist_precision = Param(
+        "highest",
+        in_array(["highest", "high", "default"]),
+        doc="MXU precision of the histogram/leaf statistic matmuls: "
+        "'highest' = exact f32 (6 bf16 passes, bit-equal to scatter); "
+        "'high' = 3-pass bf16x3 (~f32 mantissa); 'default' = single-pass "
+        "bf16 (fastest — statistics carry ~3 decimal digits, like a "
+        "subsampled histogram).  Routing stays exact on every setting.",
+    )
     seed = Param(0)
 
     def make_fit_ctx(self, X, num_classes=None):
@@ -59,6 +68,7 @@ class _TreeLearner(BaseLearner):
             max_bins=self.max_bins,
             min_info_gain=self.min_info_gain,
             axis_name=axis_name,
+            hist_precision=self.hist_precision,
         )
 
     def _targets_many(self, ctx, ys) -> jax.Array:
@@ -79,6 +89,7 @@ class _TreeLearner(BaseLearner):
             max_bins=self.max_bins,
             min_info_gain=self.min_info_gain,
             axis_name=axis_name,
+            hist_precision=self.hist_precision,
         )
 
     def ctx_specs(self, ctx, data_axis):

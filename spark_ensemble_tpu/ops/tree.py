@@ -72,6 +72,27 @@ class Tree(NamedTuple):
 _MATMUL_HIST_MAX_CELLS = 2**28
 
 
+# routing contractions (node-one-hot x small-int split tables) are exact in
+# ONE bf16 MXU pass when every operand value is an integer the bf16 mantissa
+# holds exactly (0..256): one-hots are 0/1 and bin indices are < max_bins.
+# Above that bin count, fall back to the 6-pass f32 emulation.
+_ROUTING_EXACT_MAX_BINS = 256
+
+_HIST_PRECISION = {
+    "highest": jax.lax.Precision.HIGHEST,  # 6-pass bf16 emulation of f32
+    "high": jax.lax.Precision.HIGH,  # 3-pass bf16x3 (~f32 mantissa)
+    "default": jax.lax.Precision.DEFAULT,  # single-pass bf16 inputs
+}
+
+
+def _routing_precision(B: int):
+    """Single-pass precision for the gather-free routing matmuls whenever it
+    is provably bit-exact (see _ROUTING_EXACT_MAX_BINS)."""
+    if B <= _ROUTING_EXACT_MAX_BINS:
+        return jax.lax.Precision.DEFAULT
+    return jax.lax.Precision.HIGHEST
+
+
 def _resolve_hist(hist: str, n: int, d: int, B: int) -> str:
     if hist != "auto":
         return hist
@@ -84,7 +105,10 @@ def _resolve_hist(hist: str, n: int, d: int, B: int) -> str:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("max_depth", "max_bins", "min_info_gain", "axis_name", "hist"),
+    static_argnames=(
+        "max_depth", "max_bins", "min_info_gain", "axis_name", "hist",
+        "hist_precision",
+    ),
 )
 def fit_tree(
     Xb: jax.Array,  # i32[n, d] binned features
@@ -98,12 +122,23 @@ def fit_tree(
     min_info_gain: float = 0.0,
     axis_name: Optional[str] = None,
     hist: str = "auto",  # auto | scatter | matmul
+    hist_precision: str = "highest",  # statistic-matmul MXU passes, see below
 ) -> Tree:
+    """``hist_precision`` sets the MXU precision of the STATISTIC matmuls
+    (histogram accumulation and leaf sums): "highest" is exact f32
+    (6 bf16 passes — the default, bit-equal to the scatter path), "high"
+    is 3-pass bf16x3 (~f32 mantissa; split choices rarely move), "default"
+    is single-pass bf16 inputs (~3 decimal digits on the statistics — the
+    fastest; split quality degrades gracefully like subsampled histograms).
+    Routing contractions are NOT affected: they pick single one-hot terms
+    and run single-pass whenever that is provably bit-exact."""
     n, d = Xb.shape
     k = Y.shape[1]
     B = max_bins
     num_internal = 2**max_depth - 1
     hist = _resolve_hist(hist, n, d, B)
+    stat_prec = _HIST_PRECISION[hist_precision]
+    route_prec = _routing_precision(B)
 
     preduce = lambda x: _preduce(x, axis_name)
 
@@ -144,7 +179,7 @@ def fit_tree(
                 A.T,
                 bin_oh,
                 (((1,), (0,)), ((), ())),
-                precision=jax.lax.Precision.HIGHEST,
+                precision=stat_prec,
             ).reshape(n_nodes, 1 + k, d, B)
             hist_w = H[:, 0]
             hist_wy = jnp.moveaxis(H[:, 1:], 1, -1)  # [nodes, d, B, k]
@@ -208,20 +243,21 @@ def fit_tree(
             # ~3.8 ms per n-element gather at letter scale — the dominant
             # round cost, not the histograms).  Contract the node one-hot
             # against the per-node split tables instead; every contraction
-            # selects exactly one term, so HIGHEST-precision results are
-            # bit-exact vs the gather.
+            # selects exactly one term and all values are small integers,
+            # so a single bf16 pass is bit-exact vs the gather for
+            # max_bins <= 256 (_routing_precision).
             t_row = jax.lax.dot_general(
                 node_oh,
                 best_t.astype(jnp.float32),
                 (((1,), (0,)), ((), ())),
-                precision=jax.lax.Precision.HIGHEST,
+                precision=route_prec,
             )  # [n]
             f_oh = jax.nn.one_hot(best_f, d, dtype=jnp.float32)  # [nodes, d]
             sel = jax.lax.dot_general(
                 node_oh,
                 f_oh,
                 (((1,), (0,)), ((), ())),
-                precision=jax.lax.Precision.HIGHEST,
+                precision=route_prec,
             )  # [n, d] one-hot of each row's split feature
             xb_f = jnp.sum(sel * Xb.astype(jnp.float32), axis=1)
             go_left = xb_f <= t_row
@@ -245,7 +281,7 @@ def fit_tree(
             leaf_oh.T,
             vals,
             (((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
+            precision=stat_prec,
         )  # [leaves, 1+k]
         leaf_w = preduce(L[:, 0])
         leaf_wy = preduce(L[:, 1:])
@@ -272,7 +308,10 @@ _FOREST_FUSED_MAX_CELLS = 2**28
 
 @functools.partial(
     jax.jit,
-    static_argnames=("max_depth", "max_bins", "min_info_gain", "axis_name", "hist"),
+    static_argnames=(
+        "max_depth", "max_bins", "min_info_gain", "axis_name", "hist",
+        "hist_precision",
+    ),
 )
 def fit_forest(
     Xb: jax.Array,  # i32[n, d] binned features, SHARED by all members
@@ -286,6 +325,7 @@ def fit_forest(
     min_info_gain: float = 0.0,
     axis_name: Optional[str] = None,
     hist: str = "auto",
+    hist_precision: str = "highest",  # see fit_tree
 ) -> Tree:
     """Fit M trees at once on shared binned features -> stacked ``Tree``
     (leading member axis, same structure as ``jax.vmap(fit_tree)``).
@@ -300,15 +340,17 @@ def fit_forest(
     is the XLA replacement for the reference's per-class-dim driver Futures
     (`GBMClassifier.scala:377-411`) on the histogram path itself.
 
-    Semantics are identical to ``vmap(fit_tree)``: same HIGHEST-precision
-    accumulations, same gain rule, same tie-breaking argmax, same psum
-    points under ``axis_name``.
+    Semantics are identical to ``vmap(fit_tree)``: same statistic-matmul
+    precision (``hist_precision``, default exact f32), same gain rule, same
+    tie-breaking argmax, same psum points under ``axis_name``.
     """
     n, d = Xb.shape
     _, M, k = Y.shape
     B = max_bins
     num_internal = 2**max_depth - 1
     hist = _resolve_hist(hist, n, d, B)
+    stat_prec = _HIST_PRECISION[hist_precision]
+    route_prec = _routing_precision(B)
 
     if feature_mask is None:
         feature_mask = jnp.ones((M, d), bool)
@@ -329,6 +371,7 @@ def fit_forest(
             min_info_gain=min_info_gain,
             axis_name=axis_name,
             hist=hist,
+            hist_precision=hist_precision,
         )
         return jax.vmap(fit_one, in_axes=(1, 1, 0))(Y, w, feature_mask)
 
@@ -366,7 +409,7 @@ def fit_forest(
             A.T,
             bin_oh,
             (((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
+            precision=stat_prec,
         ).reshape(M, n_nodes, 1 + k, d, B)
         hist_w = preduce(H[:, :, 0])  # [M, nodes, d, B]
         hist_wy = preduce(jnp.moveaxis(H[:, :, 1:], 2, -1))  # [M,nodes,d,B,k]
@@ -411,22 +454,23 @@ def fit_forest(
 
         # ---- route rows to children (all members at once) -----------------
         # gather-free (see fit_tree): contract the node one-hot against the
-        # split tables; each contraction picks exactly one term -> bit-exact
+        # split tables; each contraction picks exactly one small-int term ->
+        # single-pass bf16 is bit-exact for max_bins <= 256
         t_row = jnp.einsum(
             "nmo,mo->nm",
             node_oh,
             best_t.astype(jnp.float32),
-            precision=jax.lax.Precision.HIGHEST,
+            precision=route_prec,
         )
         f_oh = jax.nn.one_hot(best_f, d, dtype=jnp.float32)  # [M, nodes, d]
         sel = jnp.einsum(
-            "nmo,mod->nmd", node_oh, f_oh, precision=jax.lax.Precision.HIGHEST
+            "nmo,mod->nmd", node_oh, f_oh, precision=route_prec
         )
         xb_f = jnp.einsum(
             "nmd,nd->nm",
             sel,
             Xb.astype(jnp.float32),
-            precision=jax.lax.Precision.HIGHEST,
+            precision=route_prec,
         )
         go_left = xb_f <= t_row
         node = 2 * node + jnp.where(go_left, 0, 1)
@@ -440,7 +484,7 @@ def fit_forest(
     num_leaves = 2**max_depth
     leaf_oh = jax.nn.one_hot(node, num_leaves, dtype=jnp.float32)  # [n,M,L]
     L = jnp.einsum(
-        "nml,nmc->mlc", leaf_oh, vals, precision=jax.lax.Precision.HIGHEST
+        "nml,nmc->mlc", leaf_oh, vals, precision=stat_prec
     )
     leaf_w = preduce(L[:, :, 0])  # [M, L]
     leaf_wy = preduce(L[:, :, 1:])  # [M, L, k]

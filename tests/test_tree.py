@@ -445,3 +445,47 @@ def test_deep_tree_predict_uses_walk_fallback():
     # binned and raw predicts agree (same routing on in-range data)
     outb = np.asarray(predict_tree_binned(tree, Xb))
     assert np.allclose(out, outb)
+
+
+def test_hist_precision_tiers():
+    """'high'/'default' statistic-matmul precisions produce valid trees
+    whose quality degrades gracefully; 'highest' stays the bit-exact
+    reference tier.  (On CPU all tiers execute as f32 — exactness across
+    tiers here; the distinction is TPU MXU passes.)"""
+    import numpy as np
+
+    from spark_ensemble_tpu.models.tree import DecisionTreeRegressor
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(800, 6).astype(np.float32)
+    y = (X[:, 0] * 2 + np.sin(2 * X[:, 1]) + 0.05 * rng.randn(800)).astype(
+        np.float32
+    )
+    preds = {}
+    for tier in ("highest", "high", "default"):
+        m = DecisionTreeRegressor(hist_precision=tier).fit(X, y)
+        p = np.asarray(m.predict(X))
+        rmse = float(np.sqrt(np.mean((p - y) ** 2)))
+        assert rmse < 0.6, (tier, rmse)
+        preds[tier] = p
+    # CPU backend: every tier runs the same f32 dot -> identical trees
+    np.testing.assert_allclose(preds["highest"], preds["high"], atol=1e-6)
+
+
+def test_hist_precision_param_validated_and_persisted(tmp_path):
+    import numpy as np
+    import pytest
+
+    import spark_ensemble_tpu as se
+    from spark_ensemble_tpu.models.tree import DecisionTreeRegressor
+
+    with pytest.raises(ValueError):
+        DecisionTreeRegressor(hist_precision="bf16")
+    rng = np.random.RandomState(1)
+    X = rng.randn(100, 3).astype(np.float32)
+    y = X[:, 0].astype(np.float32)
+    m = DecisionTreeRegressor(hist_precision="high").fit(X, y)
+    m.save(str(tmp_path / "t"))
+    m2 = se.load(str(tmp_path / "t"))
+    assert m2.hist_precision == "high"
+    np.testing.assert_array_equal(np.asarray(m.predict(X)), np.asarray(m2.predict(X)))
