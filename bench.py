@@ -131,7 +131,10 @@ def main():
             # a green accelerator run is not degraded: earlier probe
             # failures are warnings, not errors
             _finish(result, [], warnings=errors)
-            if result.get("platform") not in (None, "cpu"):
+            if result.get("platform") not in (None, "cpu") and hp == "highest":
+                # only the canonical exact-precision config is committed as
+                # the real-chip capture; tier-comparison runs must not
+                # clobber it with a fast-tier number
                 # persist the perishable-window evidence AFTER _finish so
                 # the capture carries vs_baseline; later CPU-fallback runs
                 # embed it under "last_tpu"

@@ -116,6 +116,19 @@ def cached_program(key: tuple, build: Callable[[], Callable]) -> Callable:
     return fn
 
 
+def mesh_fit_kwargs(estimator, mesh) -> dict:
+    """``{'mesh': mesh}`` when the estimator's fit supports distributed
+    training, else ``{}`` — lets composite estimators (tuning, pipelines)
+    forward a mesh without caring which stages are mesh-aware."""
+    if mesh is None:
+        return {}
+    import inspect
+
+    if "mesh" in inspect.signature(estimator.fit).parameters:
+        return {"mesh": mesh}
+    return {}
+
+
 def resolve_weights(y: jax.Array, sample_weight) -> jax.Array:
     if sample_weight is None:
         return jnp.ones_like(y, dtype=jnp.float32)

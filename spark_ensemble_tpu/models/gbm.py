@@ -489,7 +489,26 @@ class GBMRegressor(_GBMParams):
                     ctx, labels[:, 0], fit_w[:, 0], mask, key, axis_name=ax
                 )
                 direction = base.predict_fn(params, X)
-                if optimized:
+                if optimized and loss_name == "squared":
+                    # phi(a) = sum bw*(res - a*dir)^2/2 is EXACTLY quadratic:
+                    # the minimizer is one data pass, not ~max_iter
+                    # sequential Brent evaluations (the reference runs Brent
+                    # even here, `GBMRegressor.scala:311,413` — same
+                    # minimizer, found in closed form), clamped to Brent's
+                    # [0, 100] bracket
+                    res = y - pred
+                    num = jnp.sum(bag_w * direction * res)
+                    den = jnp.sum(bag_w * direction * direction)
+                    if ax is not None:
+                        num = jax.lax.psum(num, ax)
+                        den = jax.lax.psum(den, ax)
+                    alpha_opt = jnp.where(
+                        den > 1e-30,
+                        jnp.clip(num / jnp.maximum(den, 1e-30), 0.0, 100.0),
+                        # zero direction: any weight is a no-op; keep 1.0
+                        jnp.asarray(1.0, jnp.float32),
+                    )
+                elif optimized:
                     def phi(a):
                         # bag-multiplicity weighting only (`GBMLoss.scala:50-74`)
                         v = jnp.sum(

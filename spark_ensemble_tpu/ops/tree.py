@@ -137,7 +137,9 @@ def fit_tree(
     B = max_bins
     num_internal = 2**max_depth - 1
     hist = _resolve_hist(hist, n, d, B)
-    stat_prec = _HIST_PRECISION[hist_precision]
+    # case-normalized here (not at the Param) so direct kernel callers get
+    # the same tolerance as estimator users
+    stat_prec = _HIST_PRECISION[hist_precision.lower()]
     route_prec = _routing_precision(B)
 
     preduce = lambda x: _preduce(x, axis_name)
@@ -349,7 +351,9 @@ def fit_forest(
     B = max_bins
     num_internal = 2**max_depth - 1
     hist = _resolve_hist(hist, n, d, B)
-    stat_prec = _HIST_PRECISION[hist_precision]
+    # case-normalized here (not at the Param) so direct kernel callers get
+    # the same tolerance as estimator users
+    stat_prec = _HIST_PRECISION[hist_precision.lower()]
     route_prec = _routing_precision(B)
 
     if feature_mask is None:

@@ -120,7 +120,14 @@ class Pipeline(Estimator):
             getattr(s, "is_classifier", False) for s in (self.stages or [])
         )
 
-    def fit(self, X, y=None, sample_weight=None, num_classes=None) -> "PipelineModel":
+    def fit(
+        self, X, y=None, sample_weight=None, num_classes=None, mesh=None
+    ) -> "PipelineModel":
+        """Fit; ``mesh`` is forwarded to every mesh-aware estimator stage
+        (the ensembles), so a scaler + distributed GBM pipeline trains the
+        GBM on the mesh."""
+        from spark_ensemble_tpu.models.base import mesh_fit_kwargs
+
         fitted: List[Any] = []
         Xc = as_f32(X)
         num_features = Xc.shape[1]
@@ -133,12 +140,14 @@ class Pipeline(Estimator):
                 if hasattr(stage, "transform"):
                     Xc = stage.transform(Xc)
             elif isinstance(stage, Estimator):
+                kw = mesh_fit_kwargs(stage, mesh)
                 if getattr(stage, "is_classifier", False):
                     model = stage.fit(
-                        Xc, y, sample_weight=sample_weight, num_classes=num_classes
+                        Xc, y, sample_weight=sample_weight,
+                        num_classes=num_classes, **kw,
                     )
                 else:
-                    model = stage.fit(Xc, y, sample_weight=sample_weight)
+                    model = stage.fit(Xc, y, sample_weight=sample_weight, **kw)
                 fitted.append(model)
                 if hasattr(model, "transform"):
                     Xc = model.transform(Xc)

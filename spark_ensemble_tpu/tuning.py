@@ -27,7 +27,7 @@ import jax
 import numpy as np
 
 from spark_ensemble_tpu.evaluation import Evaluator
-from spark_ensemble_tpu.models.base import Estimator, Model
+from spark_ensemble_tpu.models.base import Estimator, Model, mesh_fit_kwargs
 from spark_ensemble_tpu.params import Param, gt_eq, in_range
 
 logger = logging.getLogger(__name__)
@@ -75,16 +75,37 @@ def _full_num_classes(estimator, y):
     return infer_num_classes(y)
 
 
+_MESH_WARNED: set = set()
+
+
+def _mesh_kw(estimator, mesh):
+    """See ``models.base.mesh_fit_kwargs``; tuning also warns — once per
+    estimator type, not once per (map, fold) candidate — so a sweep
+    silently running single-device is visible without flooding the logs."""
+    kw = mesh_fit_kwargs(estimator, mesh)
+    if mesh is not None and not kw:
+        name = type(estimator).__name__
+        if name not in _MESH_WARNED:
+            _MESH_WARNED.add(name)
+            logger.warning(
+                "%s.fit has no mesh support; tuning runs it single-device",
+                name,
+            )
+    return kw
+
+
 def _fit_and_eval(
-    estimator, pmap, evaluator, X, y, w, train_mask, eval_mask, num_classes=None
+    estimator, pmap, evaluator, X, y, w, train_mask, eval_mask,
+    num_classes=None, mesh=None,
 ):
     est = estimator.copy(**pmap)
+    kw = _mesh_kw(est, mesh)
     Xt, yt = X[train_mask], y[train_mask]
     wt = w[train_mask] if w is not None else None
     if num_classes is not None:
-        model = est.fit(Xt, yt, sample_weight=wt, num_classes=num_classes)
+        model = est.fit(Xt, yt, sample_weight=wt, num_classes=num_classes, **kw)
     else:
-        model = est.fit(Xt, yt, sample_weight=wt)
+        model = est.fit(Xt, yt, sample_weight=wt, **kw)
     Xe, ye = X[eval_mask], y[eval_mask]
     we = w[eval_mask] if w is not None else None
     return model, evaluator.evaluate(model, Xe, ye, sample_weight=we)
@@ -106,7 +127,10 @@ class CrossValidator(_TuningParams):
 
     num_folds = Param(3, gt_eq(2))
 
-    def fit(self, X, y, sample_weight=None) -> "CrossValidatorModel":
+    def fit(self, X, y, sample_weight=None, mesh=None) -> "CrossValidatorModel":
+        """Fit; ``mesh`` flows into every (param-map, fold) estimator fit,
+        so each candidate trains distributed — the analogue of Spark CV
+        launching cluster jobs per fold."""
         X = np.asarray(X)
         y = np.asarray(y)
         w = None if sample_weight is None else np.asarray(sample_weight)
@@ -120,14 +144,16 @@ class CrossValidator(_TuningParams):
             for mi, pmap in enumerate(maps):
                 _, metric = _fit_and_eval(
                     self.estimator, pmap, evaluator, X, y, w, train_mask,
-                    eval_mask, num_classes=k,
+                    eval_mask, num_classes=k, mesh=mesh,
                 )
                 metrics[mi, fi] = metric
                 logger.info("CV fold %d map %d: %.5f", fi, mi, metric)
         avg = metrics.mean(axis=1)
         best_idx = int(np.argmax(avg) if evaluator.is_larger_better else np.argmin(avg))
         best_est = self.estimator.copy(**maps[best_idx])
-        best_model = best_est.fit(X, y, sample_weight=w)
+        best_model = best_est.fit(
+            X, y, sample_weight=w, **_mesh_kw(best_est, mesh)
+        )
         return CrossValidatorModel(
             best_model=best_model,
             avg_metrics=avg.tolist(),
@@ -167,7 +193,10 @@ class TrainValidationSplit(_TuningParams):
 
     train_ratio = Param(0.75, in_range(0.0, 1.0, lower_inclusive=False, upper_inclusive=False))
 
-    def fit(self, X, y, sample_weight=None) -> "TrainValidationSplitModel":
+    def fit(
+        self, X, y, sample_weight=None, mesh=None
+    ) -> "TrainValidationSplitModel":
+        """Fit; ``mesh`` flows into every candidate fit (see CrossValidator)."""
         X = np.asarray(X)
         y = np.asarray(y)
         w = None if sample_weight is None else np.asarray(sample_weight)
@@ -184,14 +213,17 @@ class TrainValidationSplit(_TuningParams):
         for mi, pmap in enumerate(maps):
             _, metric = _fit_and_eval(
                 self.estimator, pmap, evaluator, X, y, w, train_mask,
-                eval_mask, num_classes=k,
+                eval_mask, num_classes=k, mesh=mesh,
             )
             metrics[mi] = metric
             logger.info("TVS map %d: %.5f", mi, metric)
         best_idx = int(
             np.argmax(metrics) if evaluator.is_larger_better else np.argmin(metrics)
         )
-        best_model = self.estimator.copy(**maps[best_idx]).fit(X, y, sample_weight=w)
+        best_est = self.estimator.copy(**maps[best_idx])
+        best_model = best_est.fit(
+            X, y, sample_weight=w, **_mesh_kw(best_est, mesh)
+        )
         return TrainValidationSplitModel(
             best_model=best_model,
             validation_metrics=metrics.tolist(),

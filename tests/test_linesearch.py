@@ -114,3 +114,30 @@ def test_backtracking_recovers_from_nan_objective():
     )
     assert np.all(np.isfinite(x))
     assert np.all(np.abs(x - 0.3) < 0.1), x
+
+
+def test_squared_loss_closed_form_matches_brent():
+    """GBM's squared-loss line search is now closed form (phi is exactly
+    quadratic); the minimizer must match what Brent finds on the same
+    objective to within its tolerance."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_ensemble_tpu.ops.linesearch import brent_minimize
+
+    rng = np.random.RandomState(0)
+    for trial in range(5):
+        n = 500
+        bw = rng.poisson(1.0, n).astype(np.float32)
+        res = rng.randn(n).astype(np.float32) * 3
+        direction = (res * 0.5 + rng.randn(n)).astype(np.float32)
+        bwj, resj, dirj = map(jnp.asarray, (bw, res, direction))
+
+        def phi(a):
+            return jnp.sum(bwj * (resj - a * dirj) ** 2 / 2.0)
+
+        a_brent = float(brent_minimize(phi, 0.0, 100.0, tol=1e-6, max_iter=100))
+        num = float(np.sum(bw * direction * res))
+        den = float(np.sum(bw * direction * direction))
+        a_closed = min(max(num / den, 0.0), 100.0)
+        assert abs(a_brent - a_closed) < 1e-3, (trial, a_brent, a_closed)

@@ -181,3 +181,45 @@ def test_cv_pipeline_fold_missing_top_class():
     )
     model = cv.fit(X, y)
     assert model.best_model.num_classes == 3
+
+
+def test_cv_and_pipeline_mesh_passthrough():
+    """mesh= flows from CrossValidator / Pipeline into every mesh-aware
+    estimator fit — a CV sweep over a distributed GBM trains each
+    (param-map, fold) candidate on the mesh, like Spark CV launching
+    cluster jobs per fold."""
+    import numpy as np
+
+    from spark_ensemble_tpu import GBMClassifier
+    from spark_ensemble_tpu.evaluation import MulticlassClassificationEvaluator
+    from spark_ensemble_tpu.parallel.mesh import data_member_mesh
+    from spark_ensemble_tpu.pipeline import Pipeline, StandardScaler
+    from spark_ensemble_tpu.tuning import CrossValidator, ParamGridBuilder
+
+    rng = np.random.RandomState(6)
+    n, d, k = 640, 6, 3
+    X = rng.randn(n, d).astype(np.float32)
+    centers = rng.randn(k, d).astype(np.float32)
+    y = np.argmax(X @ centers.T + 0.5 * rng.randn(n, k), axis=1).astype(
+        np.float32
+    )
+    mesh = data_member_mesh(8, member=1)
+    grid = ParamGridBuilder().add_grid("learning_rate", [0.3, 1.0]).build()
+    cv = CrossValidator(
+        estimator=GBMClassifier(num_base_learners=2, loss="logloss"),
+        evaluator=MulticlassClassificationEvaluator(metric="accuracy"),
+        estimator_param_maps=grid,
+        num_folds=2,
+        seed=0,
+    )
+    m = cv.fit(X, y, mesh=mesh)
+    assert len(m.avg_metrics) == 2
+    assert max(m.avg_metrics) > 0.7
+
+    pipe = Pipeline(stages=[
+        StandardScaler(),
+        GBMClassifier(num_base_learners=2, loss="logloss"),
+    ])
+    pm = pipe.fit(X, y, mesh=mesh)
+    acc = float(np.mean(np.asarray(pm.predict(X)) == y))
+    assert acc > 0.7
