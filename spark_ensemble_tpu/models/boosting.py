@@ -159,6 +159,8 @@ class _BoostingParams(CheckpointableParams, Estimator):
                         "est_weights": concat_pytrees(weights_chunks),
                     },
                 )
+        # join the in-flight async save before the model is assembled
+        ckpt.wait()
         return i
 
 

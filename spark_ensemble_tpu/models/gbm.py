@@ -338,6 +338,10 @@ class _GBMParams(CheckpointableParams, Estimator):
             if not stopped:
                 i += c
                 save_state(i - 1, v, best)
+        # the loop must not end with a dangling background write: join the
+        # in-flight async save (and surface its failure) before the model
+        # is assembled
+        ckpt.wait()
         return i, v, best
 
 

@@ -167,3 +167,65 @@ def test_gbm_resume_with_changed_interval_keeps_saving(tmp_path):
     b = np.asarray(resumed.predict(X[:100]))
     assert resumed.num_members == full.num_members == 12
     assert np.allclose(a, b, atol=1e-4), np.abs(a - b).max()
+
+
+def test_async_save_roundtrip_and_failure_propagation(tmp_path):
+    """Async saves must land atomically with identical contents to sync
+    saves, and a failed background write must re-raise at the next
+    checkpointer call (same surface as a synchronous failure)."""
+    import jax.numpy as jnp
+
+    sync = TrainingCheckpointer(
+        str(tmp_path / "sync"), interval=1, async_save=False
+    )
+    asy = TrainingCheckpointer(str(tmp_path / "async"), interval=1)
+    state = {
+        "v": 3,
+        "pred": jnp.arange(16.0),
+        "members": {"leaf": jnp.ones((4, 2))},
+    }
+    sync.save(0, state)
+    asy.save(0, state)
+    asy.wait()
+    rs, ss = sync.load_latest()[1], asy.load_latest()[1]
+    assert np.allclose(np.asarray(ss["pred"]), np.asarray(rs["pred"]))
+    assert np.allclose(
+        np.asarray(ss["members"]["leaf"]), np.asarray(rs["members"]["leaf"])
+    )
+
+    # overlapping saves keep ordering: the LAST save wins 'latest'
+    for i in range(5):
+        asy.save(i, {"v": i, "pred": jnp.full((8,), float(i))})
+    rnd, st = asy.load_latest()
+    assert rnd == 4 and float(np.asarray(st["pred"])[0]) == 4.0
+
+    # failure propagation: unpicklable/unencodable state fails in the
+    # writer thread and surfaces at the next wait()/save()
+    class Weird:
+        pass
+
+    asy.save(5, {"bad": Weird()})
+    import pytest
+
+    with pytest.raises(Exception):
+        asy.wait()
+    asy.delete()
+
+
+def test_gbm_fit_with_async_checkpointing_matches(tmp_path):
+    """End-to-end: a fit whose periodic saves run async must produce the
+    same model as one with checkpointing off (saves are pure side
+    effects)."""
+    import spark_ensemble_tpu as se
+
+    X, y = _data(600)
+    plain = se.GBMRegressor(num_base_learners=6, seed=3).fit(X, y)
+    ck = se.GBMRegressor(
+        num_base_learners=6, seed=3,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_interval=2,
+        scan_chunk=2,
+    ).fit(X, y)
+    np.testing.assert_allclose(
+        np.asarray(plain.predict(X[:100])), np.asarray(ck.predict(X[:100])),
+        atol=1e-5,
+    )
