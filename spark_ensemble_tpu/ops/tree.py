@@ -93,6 +93,31 @@ def _routing_precision(B: int):
     return jax.lax.Precision.HIGHEST
 
 
+def _prefix_sums(hist_w, hist_wy, bins_axis_w, stat_prec, hist):
+    """Left-prefix sums over the bins axis of the histogram stats.
+
+    Exact tier (or scatter hist path): ``jnp.cumsum`` — bit-identical
+    summation order to the scatter path (the pinned scatter-vs-matmul
+    parity invariant).  Fast tiers on the matmul path trade that ulp-level
+    order identity away anyway, so they compute the prefix sums as ONE
+    batched matmul against a triangular 0/1 matrix — an MXU op instead of
+    a sequential scan, attacking the per-level cumsum tail in the round
+    profile.  The tier policy lives HERE, next to the code it selects."""
+    fast_tier = hist == "matmul" and stat_prec != jax.lax.Precision.HIGHEST
+    if not fast_tier:
+        return (
+            jnp.cumsum(hist_w, axis=bins_axis_w),
+            jnp.cumsum(hist_wy, axis=bins_axis_w),
+        )
+    B = hist_w.shape[bins_axis_w]
+    tri = jnp.triu(jnp.ones((B, B), jnp.float32))  # tri[b, c] = 1[b <= c]
+    prec = _stat_precision_vs_onehot(stat_prec)
+    assert bins_axis_w == hist_w.ndim - 1 and bins_axis_w == hist_wy.ndim - 2
+    cw = jnp.einsum("...b,bc->...c", hist_w, tri, precision=prec)
+    cwy = jnp.einsum("...bk,bc->...ck", hist_wy, tri, precision=prec)
+    return cw, cwy
+
+
 def _stat_precision_vs_onehot(stat_prec):
     """Per-operand precision for statistic matmuls whose OTHER side is a
     pure 0/1 one-hot: the one-hot is exactly bf16-representable, so it
@@ -133,14 +158,16 @@ def fit_tree(
     hist: str = "auto",  # auto | scatter | matmul
     hist_precision: str = "highest",  # statistic-matmul MXU passes, see below
 ) -> Tree:
-    """``hist_precision`` sets the MXU precision of the STATISTIC matmuls
-    (histogram accumulation and leaf sums): "highest" is exact f32
-    (6 bf16 passes — the default, bit-equal to the scatter path), "high"
-    is 3-pass bf16x3 (~f32 mantissa; split choices rarely move), "default"
-    is single-pass bf16 inputs (~3 decimal digits on the statistics — the
-    fastest; split quality degrades gracefully like subsampled histograms).
-    Routing contractions are NOT affected: they pick single one-hot terms
-    and run single-pass whenever that is provably bit-exact."""
+    """``hist_precision`` sets the MXU precision of the STATISTIC math
+    (histogram accumulation, leaf sums, and — on the fast tiers — the bin
+    prefix sums, which switch from an exact cumsum scan to a triangular
+    matmul): "highest" is exact f32 (bit-equal to the scatter path),
+    "high" is 3-pass bf16x3 (~f32 mantissa; split choices rarely move),
+    "default" is single-pass bf16 inputs (~3 decimal digits on the
+    statistics — the fastest; split quality degrades gracefully like
+    subsampled histograms).  Routing contractions are NOT affected: they
+    pick single one-hot terms and run single-pass whenever that is
+    provably bit-exact."""
     n, d = Xb.shape
     k = Y.shape[1]
     B = max_bins
@@ -212,8 +239,9 @@ def fit_tree(
         hist_wy = preduce(hist_wy)
 
         # ---- candidate split scores via cumulative sums over bins ---------
-        cw = jnp.cumsum(hist_w, axis=2)  # [nodes, d, B]
-        cwy = jnp.cumsum(hist_wy, axis=2)  # [nodes, d, B, k]
+        cw, cwy = _prefix_sums(
+            hist_w, hist_wy, 2, stat_prec, hist
+        )  # [nodes, d, B], [nodes, d, B, k]
         W = cw[:, :1, -1:]  # [nodes, 1, 1] node total weight
         S = cwy[:, :1, -1:, :]  # [nodes, 1, 1, k] node total sums
         WL = cw[:, :, : B - 1]
@@ -428,8 +456,7 @@ def fit_forest(
         hist_wy = preduce(jnp.moveaxis(H[:, :, 1:], 2, -1))  # [M,nodes,d,B,k]
 
         # ---- candidate split scores (same rule as fit_tree) ---------------
-        cw = jnp.cumsum(hist_w, axis=3)
-        cwy = jnp.cumsum(hist_wy, axis=3)
+        cw, cwy = _prefix_sums(hist_w, hist_wy, 3, stat_prec, hist)
         W = cw[:, :, :1, -1:]  # [M, nodes, 1, 1]
         S = cwy[:, :, :1, -1:, :]  # [M, nodes, 1, 1, k]
         WL = cw[:, :, :, : B - 1]

@@ -489,3 +489,52 @@ def test_hist_precision_param_validated_and_persisted(tmp_path):
     m2 = se.load(str(tmp_path / "t"))
     assert m2.hist_precision == "high"
     np.testing.assert_array_equal(np.asarray(m.predict(X)), np.asarray(m2.predict(X)))
+
+
+def test_fast_tier_matmul_prefix_sums_metric_parity():
+    """Fast precision tiers compute bin prefix sums as triangular matmuls
+    (MXU) instead of cumsum scans; vs the exact tier the trees may differ
+    by ulp-order split flips only — model quality must match."""
+    from spark_ensemble_tpu.ops.tree import fit_forest
+
+    rng = np.random.RandomState(3)
+    n, d = 900, 7
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] * 2 + np.cos(2 * X[:, 1]) + 0.05 * rng.randn(n)).astype(
+        np.float32
+    )
+    b = compute_bins(X, 32)
+    Xb = bin_features(X, b)
+    w = np.ones((n,), np.float32)
+    kw = dict(max_depth=4, max_bins=32, hist="matmul")
+    t_exact = fit_tree(Xb, y[:, None], w, b.thresholds, **kw)
+    t_fast = fit_tree(
+        Xb, y[:, None], w, b.thresholds, hist_precision="high", **kw
+    )
+    p_exact = np.asarray(predict_tree_binned(t_exact, Xb))
+    p_fast = np.asarray(predict_tree_binned(t_fast, Xb))
+    r_e = float(np.sqrt(np.mean((p_exact[:, 0] - y) ** 2)))
+    r_f = float(np.sqrt(np.mean((p_fast[:, 0] - y) ** 2)))
+    assert abs(r_e - r_f) < 0.02 * max(r_e, r_f) + 1e-6, (r_e, r_f)
+
+    # forest flavor: the fused fast-tier path must match the exact-tier
+    # forest at the metric level too (same bar as the single-tree half)
+    M = 3
+    Y = np.broadcast_to(y[:, None, None], (n, M, 1)).copy()
+    W = rng.rand(n, M).astype(np.float32) + 0.5
+    f_exact = fit_forest(Xb, Y, W, b.thresholds, **kw)
+    f_fast = fit_forest(
+        Xb, Y, W, b.thresholds, hist_precision="default", **kw
+    )
+    import jax
+
+    for f in (f_exact, f_fast):
+        assert f.leaf_value.shape[0] == M
+    pe = np.asarray(jax.vmap(
+        lambda t: predict_tree_binned(t, Xb))(f_exact))
+    pf = np.asarray(jax.vmap(
+        lambda t: predict_tree_binned(t, Xb))(f_fast))
+    for m in range(M):
+        r_e = float(np.sqrt(np.mean((pe[m, :, 0] - y) ** 2)))
+        r_f = float(np.sqrt(np.mean((pf[m, :, 0] - y) ** 2)))
+        assert abs(r_e - r_f) < 0.03 * max(r_e, r_f) + 1e-6, (m, r_e, r_f)
