@@ -1,0 +1,147 @@
+"""Trace analysis for ``profile_dir`` captures: where does the round go?
+
+Every estimator fit can capture a ``jax.profiler`` trace (the ``profile_dir``
+param, `utils/instrumentation.py`).  This module turns that capture into the
+per-op cost table that drives kernel work — the workflow that found the
+round-2 wins (per-row gathers at ~3.8 ms each dominating the GBM round;
+`ops/tree.py` module docstring):
+
+    est = GBMClassifier(num_base_learners=20, profile_dir="/tmp/prof")
+    est.fit(X, y)
+    python -m spark_ensemble_tpu.utils.profiling /tmp/prof
+
+The summary groups trace slices by op name and reports total/mean duration
+and call counts, descending — `kCustom fusion ... gather` rows near the top
+mean serialized per-row gathers; big `dot` rows are the (expected) MXU time.
+To map fusion names back to source, lower the jitted fn and read
+``compiled.as_text()`` metadata (``op_name``/``source_line``).
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+
+def find_trace_files(trace_dir: str, latest_only: bool = True) -> List[str]:
+    """``*.trace.json.gz`` files under a profile capture directory.
+
+    jax writes each capture under a fresh ``plugins/profile/<timestamp>/``
+    subdirectory, and profile_dir is typically a REUSED fixed path — so by
+    default only the latest capture is returned; summing across captures
+    would silently merge pre- and post-change runs into one misleading
+    table.  ``latest_only=False`` merges all captures."""
+    files = sorted(
+        glob.glob(
+            os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
+        )
+    )
+    if not latest_only or not files:
+        return files
+    by_capture: Dict[str, List[str]] = {}
+    for f in files:
+        by_capture.setdefault(os.path.dirname(f), []).append(f)
+    # timestamp directory names sort lexicographically
+    return by_capture[max(by_capture)]
+
+
+def load_trace_events(path: str) -> List[dict]:
+    """Complete ("X"-phase) slice events of one chrome-trace file."""
+    with gzip.open(path, "rt") as f:
+        trace = json.load(f)
+    return [
+        e
+        for e in trace.get("traceEvents", [])
+        if e.get("ph") == "X" and "dur" in e
+    ]
+
+
+def summarize_events(
+    events: List[dict], device_only: bool = True
+) -> List[Tuple[str, float, int]]:
+    """Aggregate slice durations by event name -> [(name, total_us, count)]
+    sorted by total descending.  ``device_only`` keeps XLA-op-looking names
+    (fusions, dots, convolutions, collectives) and drops host/python rows,
+    which otherwise double-count the device time they merely wait on."""
+    totals: Dict[str, List[float]] = {}
+    for e in events:
+        name = e.get("name", "?")
+        if device_only and (
+            name.startswith(("$", "Thread", "process_"))
+            or "python" in name.lower()
+        ):
+            continue
+        slot = totals.setdefault(name, [0.0, 0])
+        slot[0] += float(e["dur"])
+        slot[1] += 1
+    return sorted(
+        ((n, v[0], int(v[1])) for n, v in totals.items()),
+        key=lambda t: -t[1],
+    )
+
+
+def summarize_trace(
+    trace_dir: str,
+    top: int = 25,
+    device_only: bool = True,
+    latest_only: bool = True,
+) -> Tuple[List[Tuple[str, float, int]], float]:
+    """``(top rows, grand_total_us)`` for the (latest) capture — the total
+    covers EVERY aggregated op, not just the displayed rows, so percentage
+    shares stay honest after truncation."""
+    events: List[dict] = []
+    for path in find_trace_files(trace_dir, latest_only=latest_only):
+        events.extend(load_trace_events(path))
+    rows = summarize_events(events, device_only=device_only)
+    total = sum(r[1] for r in rows)
+    return rows[:top], total
+
+
+def format_summary(
+    rows: List[Tuple[str, float, int]], total_us: Optional[float] = None
+) -> str:
+    total = total_us if total_us else (sum(r[1] for r in rows) or 1.0)
+    lines = [f"{'total_ms':>10}  {'%':>5}  {'count':>6}  op"]
+    for name, us, count in rows:
+        lines.append(
+            f"{us / 1000.0:>10.3f}  {100.0 * us / total:>5.1f}  "
+            f"{count:>6d}  {name[:100]}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument(
+        "--all-events",
+        action="store_true",
+        help="include host/python rows, not just device-op-looking names",
+    )
+    ap.add_argument(
+        "--merge-captures",
+        action="store_true",
+        help="sum across ALL captures under the dir (default: latest only)",
+    )
+    args = ap.parse_args(argv)
+    rows, total = summarize_trace(
+        args.trace_dir,
+        top=args.top,
+        device_only=not args.all_events,
+        latest_only=not args.merge_captures,
+    )
+    if not rows:
+        print(f"no trace events found under {args.trace_dir}")
+        return 1
+    print(format_summary(rows, total))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

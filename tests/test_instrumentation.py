@@ -49,3 +49,26 @@ def test_instrumented_logs_exceptions(caplog):
             with instrumented("boom.fit"):
                 raise RuntimeError("x")
     assert "[boom.fit] failed" in caplog.text
+
+
+def test_trace_summary_from_profile_capture(tmp_path):
+    """profile_dir capture -> utils.profiling summary: the op-cost table
+    that drives kernel work must be producible from a fit's own trace."""
+    import numpy as np
+
+    from spark_ensemble_tpu import DecisionTreeRegressor
+    from spark_ensemble_tpu.utils import profiling
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, 5).astype(np.float32)
+    y = X[:, 0].astype(np.float32)
+    prof = str(tmp_path / "prof")
+    DecisionTreeRegressor(profile_dir=prof).fit(X, y)
+    assert profiling.find_trace_files(prof), "no trace files captured"
+    rows, total = profiling.summarize_trace(prof, top=10)
+    assert rows and all(r[1] > 0 for r in rows)
+    assert total >= sum(r[1] for r in rows)  # % base covers ALL ops
+    text = profiling.format_summary(rows, total)
+    assert "total_ms" in text and len(text.splitlines()) >= 2
+    # CLI path
+    assert profiling.main([prof, "--top", "5"]) == 0
