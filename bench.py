@@ -315,10 +315,7 @@ def _bench_large_extras():
         # one program per distinct chunk length (16 and the remainder), so a
         # 1-round warmup would leave both compiles inside the timed window
         est.fit(X, y)
-        t0 = _time.perf_counter()
-        model = est.fit(X, y)
-        jax.block_until_ready(jax.tree_util.tree_leaves(model.params))
-        fit_s = _time.perf_counter() - t0
+        model, fit_s = _timed_fit(est, X, y)
         flops = _flops_per_round(n, d, k, 5, 64)
         platform = jax.devices()[0].platform
         out = {
@@ -334,6 +331,20 @@ def _bench_large_extras():
         return out
     except Exception as e:  # noqa: BLE001 - carry the error, keep going
         return {"large_error": str(e)[:200]}
+
+
+def _timed_fit(est, X, y):
+    """(model, seconds) with device work INCLUDED: every timed fit in this
+    file blocks on the model params so async dispatch cannot undercount —
+    one protocol for the headline, tier, and large-batch numbers."""
+    import time as _time
+
+    import jax
+
+    t0 = _time.perf_counter()
+    model = est.fit(X, y)
+    jax.block_until_ready(jax.tree_util.tree_leaves(model.params))
+    return model, _time.perf_counter() - t0
 
 
 def inner():
@@ -370,9 +381,7 @@ def inner():
     # would leave the length-16 and remainder compiles in the timed window
     est.fit(X, y)
 
-    t0 = time.perf_counter()
-    model = est.fit(X, y)
-    fit_s = time.perf_counter() - t0
+    model, fit_s = _timed_fit(est, X, y)
     iters_per_sec = num_rounds / fit_s
 
     # predict throughput (argmax path; jitted, steady-state)
@@ -393,6 +402,29 @@ def inner():
         extras = _bench_full_extras()
     if os.environ.get("BENCH_LARGE") == "1":
         extras.update(_bench_large_extras())
+    if os.environ.get("BENCH_TIERS") == "1":
+        # one run captures the whole hist_precision comparison (a TPU
+        # window is perishable; see BASELINE.md): re-fit at the OTHER
+        # tiers — the main number above already covers hist_precision —
+        # and report their round rates + accuracy deltas
+        for tier in ("highest", "high", "default"):
+            if tier == hist_precision:
+                continue
+            try:
+                t_est = est.copy(
+                    base_learner=DecisionTreeRegressor(hist_precision=tier)
+                )
+                t_est.fit(X, y)  # warmup/compile
+                t_model, t_fit = _timed_fit(t_est, X, y)
+                t_acc = float(
+                    np.mean(np.asarray(t_model.predict(Xd)) == y)
+                )
+                extras[f"tier_{tier}_iters_per_sec"] = round(
+                    num_rounds / t_fit, 3
+                )
+                extras[f"tier_{tier}_train_accuracy"] = round(t_acc, 4)
+            except Exception as e:  # noqa: BLE001 - carry, keep going
+                extras[f"tier_{tier}_error"] = str(e)[:200]
 
     flops = _flops_per_round(X.shape[0], X.shape[1], 26, 5, 64)
     platform = jax.devices()[0].platform
