@@ -93,15 +93,15 @@ class _BaggingParams(Estimator):
         members (trimmed by the caller); rows pad with zero-weight rows —
         both leave every statistic unchanged.  On a data-only mesh (no
         "member" axis) members replicate and only rows shard."""
-        from spark_ensemble_tpu.models.gbm import (
-            _mesh_row_spec,
-            _mesh_sizes,
-            _pad_rows,
+        from spark_ensemble_tpu.parallel.mesh import (
+            mesh_row_spec,
+            mesh_sizes,
+            pad_rows,
             shard_ctx_rows,
         )
 
-        data_size, member_size = _mesh_sizes(mesh)
-        ax = _mesh_row_spec(mesh)
+        data_size, member_size = mesh_sizes(mesh)
+        ax = mesh_row_spec(mesh)
         mem = "member" if "member" in mesh.axis_names else None
         n = y.shape[0]
         n_pad = n + (-n) % data_size
@@ -119,7 +119,7 @@ class _BaggingParams(Estimator):
             ctx_specs,
             ax,
             mem,
-            jax.device_put(_pad_rows(y, n_pad), NamedSharding(mesh, P(ax))),
+            jax.device_put(pad_rows(y, n_pad), NamedSharding(mesh, P(ax))),
             jax.device_put(fit_w, NamedSharding(mesh, P(mem, ax))),
             jax.device_put(masks, NamedSharding(mesh, P(mem, None))),
             jax.device_put(keys, NamedSharding(mesh, P(mem, None))),

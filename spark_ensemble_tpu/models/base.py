@@ -441,7 +441,13 @@ class BaseLearner(Estimator):
     # standalone sklearn-style fit built on the functional protocol
     # ------------------------------------------------------------------
     @instrumented_fit
-    def fit(self, X, y, sample_weight=None, num_classes=None) -> Model:
+    def fit(self, X, y, sample_weight=None, num_classes=None, mesh=None) -> Model:
+        """Fit this learner standalone; with ``mesh`` the fit runs as one
+        shard_map-ed SPMD program with rows sharded over "data" — every
+        built-in learner already psums its sufficient statistics over
+        ``axis_name`` (the protocol contract, see ``fit_from_ctx``), so the
+        SAME functional fit that ensembles distribute works distributed
+        here, zero per-learner code.  (Padding rows carry weight 0.)"""
         X = as_f32(X)
         y = as_f32(y)
         w = resolve_weights(y, sample_weight)
@@ -450,5 +456,43 @@ class BaseLearner(Estimator):
         )
         ctx = self.make_fit_ctx(X, num_classes)
         key = jax.random.PRNGKey(getattr(self, "seed", 0) or 0)
-        params = self.fit_from_ctx(ctx, y, w, None, key)
+        if mesh is None:
+            params = self.fit_from_ctx(ctx, y, w, None, key)
+            return self.model_from_params(params, X.shape[1], num_classes)
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from spark_ensemble_tpu.parallel.mesh import (
+            mesh_row_spec,
+            mesh_sizes,
+            pad_rows,
+            shard_ctx_rows,
+        )
+
+        data_size, _ = mesh_sizes(mesh)
+        ax = mesh_row_spec(mesh)
+        n_pad = y.shape[0] + (-y.shape[0]) % data_size
+        ctx, ctx_specs = shard_ctx_rows(mesh, self, ctx, n_pad)
+        row = jax.sharding.NamedSharding(mesh, P(ax))
+        y = jax.device_put(pad_rows(y, n_pad), row)
+        w = jax.device_put(pad_rows(w, n_pad), row)
+        # snapshot: the cached program must not observe later set_params
+        # mutations of the caller's instance (same discipline as ensembles)
+        base = self.copy()
+        fit_sharded = cached_program(
+            ("base_fit_sharded", base.config_key(), num_classes, mesh),
+            lambda: jax.jit(
+                shard_map(
+                    lambda ctx, y, w, key: base.fit_from_ctx(
+                        ctx, y, w, None, key, axis_name=ax
+                    ),
+                    mesh=mesh,
+                    in_specs=(ctx_specs, P(ax), P(ax), P()),
+                    out_specs=P(),
+                    check_vma=False,
+                )
+            ),
+        )
+        params = fit_sharded(ctx, y, w, key)
         return self.model_from_params(params, X.shape[1], num_classes)

@@ -64,10 +64,12 @@ from spark_ensemble_tpu.models.base import (
     resolve_weights,
 )
 from spark_ensemble_tpu.models.gbm import (
-    _mesh_row_spec,
     concat_pytrees,
-    setup_row_sharding,
     slice_pytree,
+)
+from spark_ensemble_tpu.parallel.mesh import (
+    mesh_row_spec as _mesh_row_spec,
+    setup_row_sharding,
 )
 from spark_ensemble_tpu.models.tree import (
     DecisionTreeClassifier,

@@ -43,10 +43,21 @@ class GaussianNaiveBayes(BaseLearner):
         mean = preduce(wc.T @ X) / jnp.maximum(class_w[:, None], 1e-30)  # [k, d]
         sq = preduce(wc.T @ (X * X))
         var = sq / jnp.maximum(class_w[:, None], 1e-30) - mean * mean
-        # global unweighted feature variance for the smoothing floor
-        n_glob = preduce(jnp.asarray(X.shape[0], jnp.float32))
-        x_mu = preduce(jnp.sum(X, axis=0)) / n_glob
-        x_var = preduce(jnp.sum((X - x_mu[None, :]) ** 2, axis=0)) / n_glob
+        # global feature variance for the smoothing floor, over PRESENT
+        # rows only (w > 0): zero-weight rows are out-of-bag samples or
+        # mesh padding and must not shift the floor — the "padding rows
+        # carry weight 0" contract every learner honors
+        present = (w > 0).astype(jnp.float32)
+        n_glob = jnp.maximum(preduce(jnp.sum(present)), 1.0)
+        x_mu = preduce(jnp.sum(X * present[:, None], axis=0)) / n_glob
+        x_var = (
+            preduce(
+                jnp.sum(
+                    ((X - x_mu[None, :]) ** 2) * present[:, None], axis=0
+                )
+            )
+            / n_glob
+        )
         var = jnp.maximum(var, 0.0) + self.var_smoothing * jnp.maximum(
             x_var, 1e-12
         )

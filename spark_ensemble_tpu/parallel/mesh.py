@@ -20,6 +20,7 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -114,3 +115,126 @@ def pad_to_multiple(x, multiple: int, axis: int = 0, fill=0.0):
     pad_width = [(0, 0)] * x.ndim
     pad_width[axis] = (0, rem)
     return jnp.pad(x, pad_width, constant_values=fill), n
+
+
+# ---------------------------------------------------------------------------
+# Row-sharding helpers shared by every row-sharding estimator fit (GBM,
+# Boosting, Bagging, standalone base learners).  They live here — the
+# neutral parallel layer — so foundational modules (models/base.py) never
+# import from a downstream estimator module.
+# ---------------------------------------------------------------------------
+
+def pad_rows(arr, n_pad: int):
+    """Zero-pad axis 0 to ``n_pad`` rows (padding rows carry weight 0
+    downstream, so statistics are unchanged)."""
+    rem = n_pad - arr.shape[0]
+    if rem == 0:
+        return arr
+    return jnp.pad(arr, [(0, rem)] + [(0, 0)] * (arr.ndim - 1))
+
+
+def pad_ctx_rows(ctx, specs, n_pad: int, data_axis: str = "data"):
+    """Pad every row-indexed ctx leaf (per its shard spec) to ``n_pad``."""
+
+    def pad(leaf, spec):
+        if len(spec) > 0 and spec[0] == data_axis:
+            return pad_rows(leaf, n_pad)
+        return leaf
+
+    return jax.tree_util.tree_map(pad, ctx, specs)
+
+
+def shard_put(tree, specs, mesh: Mesh):
+    """device_put a pytree with NamedShardings built from its spec pytree."""
+    shardings = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs)
+    return jax.device_put(tree, shardings)
+
+
+def shard_ctx_rows(mesh: Mesh, base, ctx, n_pad: int):
+    """Pad the fit ctx to the data-axis size and device_put it row-sharded
+    (over "data", or ("dcn_data", "data") on a hybrid multi-slice mesh).
+    Returns ``(ctx, ctx_specs)``.  Shared by every row-sharding estimator
+    (GBM, Boosting, Bagging)."""
+    row_spec = mesh_row_spec(mesh)
+    ctx_specs = base.ctx_specs(ctx, row_spec)
+    ctx = shard_put(
+        pad_ctx_rows(ctx, ctx_specs, n_pad, data_axis=row_spec),
+        ctx_specs,
+        mesh,
+    )
+    return ctx, ctx_specs
+
+
+def shard_fit_rows(mesh: Mesh, base, ctx, X, n_pad: int):
+    """``shard_ctx_rows`` plus the feature matrix (estimators whose round
+    step predicts on X: GBM, Boosting; see also ``setup_row_sharding``)."""
+    ctx, _ = shard_ctx_rows(mesh, base, ctx, n_pad)
+    X = jax.device_put(
+        pad_rows(X, n_pad), NamedSharding(mesh, PartitionSpec(mesh_row_spec(mesh), None))
+    )
+    return ctx, X
+
+
+def setup_row_sharding(mesh: Mesh, base, ctx, X, n: int, row_vectors=()):
+    """The full mesh row-sharding preamble shared by every row-sharding
+    estimator fit: resolve the row axis spec and padded length, pad+shard
+    the fit ctx and feature matrix, and pad+shard each 1-D per-row vector
+    (labels, weights, validity masks).  Returns
+    ``(ctx, X, ax, n_pad, sharded_vectors)``."""
+    data_size, _ = mesh_sizes(mesh)
+    ax = mesh_row_spec(mesh)
+    n_pad = n + (-n) % data_size
+    ctx, X = shard_fit_rows(mesh, base, ctx, X, n_pad)
+    row = NamedSharding(mesh, PartitionSpec(ax))
+    vecs = tuple(jax.device_put(pad_rows(v, n_pad), row) for v in row_vectors)
+    return ctx, X, ax, n_pad, vecs
+
+
+def shard_validation_rows(mesh: Mesh, n_val: int, vectors=(), matrices=()):
+    """Pad+shard a validation split over the row axis for in-chunk SPMD
+    evaluation (shared by both GBM flavors).  Returns
+    ``(nv_pad, valid_mask, sharded_vectors, sharded_matrices)`` — the mask
+    is 1.0 on real rows, 0.0 on padding, so weighted val-loss means ignore
+    the padding."""
+    data_size, _ = mesh_sizes(mesh)
+    ax = mesh_row_spec(mesh)
+    nv_pad = n_val + (-n_val) % data_size
+    row = NamedSharding(mesh, PartitionSpec(ax))
+    row2 = NamedSharding(mesh, PartitionSpec(ax, None))
+    valid = jax.device_put(
+        pad_rows(jnp.ones((n_val,), jnp.float32), nv_pad), row
+    )
+    vecs = tuple(jax.device_put(pad_rows(v, nv_pad), row) for v in vectors)
+    mats = tuple(jax.device_put(pad_rows(m, nv_pad), row2) for m in matrices)
+    return nv_pad, valid, vecs, mats
+
+
+def mesh_row_axes(mesh: Mesh):
+    """Mesh axes rows shard over: ("dcn_data", "data") on a multi-slice
+    hybrid mesh (`parallel/mesh.py:hybrid_data_member_mesh`) — row
+    reductions then psum over BOTH, i.e. a fast ICI reduction per slice
+    plus one cross-slice DCN hop — else just ("data",)."""
+    if "dcn_data" in mesh.axis_names:
+        return ("dcn_data", "data")
+    return ("data",)
+
+
+def mesh_sizes(mesh: Mesh):
+    if "data" not in mesh.axis_names:
+        raise ValueError(
+            f"mesh must have a 'data' axis; got axes {mesh.axis_names}"
+        )
+    member = int(mesh.shape.get("member", 1))
+    data = 1
+    for a in mesh_row_axes(mesh):
+        data *= int(mesh.shape[a])
+    return data, member
+
+
+def mesh_row_spec(mesh: Mesh):
+    """PartitionSpec entry (and psum axis_name) for the row axis: the plain
+    string "data", or the ("dcn_data", "data") tuple on a hybrid mesh."""
+    axes = mesh_row_axes(mesh)
+    return axes if len(axes) > 1 else "data"
+
+

@@ -542,3 +542,40 @@ def test_stacking_members_placed_across_devices(mesh8):
         np.asarray(dist.predict_proba(X[:200])),
         rtol=2e-3, atol=2e-3,
     )
+
+
+def test_base_learner_standalone_mesh_fit(mesh8):
+    """EVERY base learner trains distributed standalone through the one
+    generic shard_map fit (the protocol's axis_name contract): trees,
+    logistic/linear, naive bayes, dummy — pointwise parity with the
+    single-device fit."""
+    from spark_ensemble_tpu.models.dummy import DummyClassifier, DummyRegressor
+    from spark_ensemble_tpu.models.linear import (
+        LinearRegression,
+        LogisticRegression,
+    )
+    from spark_ensemble_tpu.models.naive_bayes import GaussianNaiveBayes
+    from spark_ensemble_tpu.models.tree import (
+        DecisionTreeClassifier,
+        DecisionTreeRegressor,
+    )
+
+    Xr, yr = _reg_data()
+    Xc, yc = _cls_data()
+    cases = [
+        (DecisionTreeRegressor(max_depth=3), Xr, yr, 1e-3),
+        (LinearRegression(), Xr, yr, 2e-3),
+        (DummyRegressor(strategy="median"), Xr, yr, 1e-5),
+        (DecisionTreeClassifier(max_depth=3), Xc, yc, 1e-3),
+        (DummyClassifier(strategy="prior"), Xc, yc, 1e-5),
+        (LogisticRegression(max_iter=25), Xc, yc, 5e-3),
+        (GaussianNaiveBayes(), Xc, yc, 1e-3),
+    ]
+    for est, X, y, tol in cases:
+        single = est.copy().fit(X, y)
+        dist = est.copy().fit(X, y, mesh=mesh8)
+        np.testing.assert_allclose(
+            np.asarray(single.predict(X)), np.asarray(dist.predict(X)),
+            rtol=tol, atol=tol,
+            err_msg=type(est).__name__,
+        )
