@@ -131,10 +131,15 @@ def main():
             # a green accelerator run is not degraded: earlier probe
             # failures are warnings, not errors
             _finish(result, [], warnings=errors)
-            if result.get("platform") not in (None, "cpu") and hp == "highest":
-                # only the canonical exact-precision config is committed as
-                # the real-chip capture; tier-comparison runs must not
-                # clobber it with a fast-tier number
+            canonical = (
+                result.get("platform") not in (None, "cpu")
+                and hp == "highest"
+                and int(result.get("num_rounds") or 0) >= 100
+            )
+            if canonical:
+                # only the canonical config (exact precision, full round
+                # count) is committed as the real-chip capture; smoke runs
+                # and tier comparisons must not clobber it
                 # persist the perishable-window evidence AFTER _finish so
                 # the capture carries vs_baseline; later CPU-fallback runs
                 # embed it under "last_tpu"
