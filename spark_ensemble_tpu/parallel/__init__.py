@@ -1,7 +1,13 @@
+from spark_ensemble_tpu.parallel import multihost
 from spark_ensemble_tpu.parallel.mesh import (
     create_mesh,
     data_member_mesh,
     hybrid_data_member_mesh,
 )
 
-__all__ = ["create_mesh", "data_member_mesh", "hybrid_data_member_mesh"]
+__all__ = [
+    "create_mesh",
+    "data_member_mesh",
+    "hybrid_data_member_mesh",
+    "multihost",
+]

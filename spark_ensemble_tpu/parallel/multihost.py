@@ -1,0 +1,100 @@
+"""Multi-host (multi-process) initialization — the connect-to-cluster step.
+
+The reference's distribution story starts with a SparkSession bound to a
+master (`local[*]` in its tests, a cluster URL in production); everything
+after that is RDD mechanics.  The TPU-native analogue: each host process
+calls :func:`initialize` once (the ``jax.distributed`` rendezvous — on Cloud
+TPU pods the coordinator/process count/index are auto-detected from the TPU
+metadata), after which ``jax.devices()`` spans EVERY host's chips and the
+``parallel.mesh`` constructors build global meshes whose collectives ride
+ICI within a slice and DCN across slices/hosts.  Estimator ``fit(...,
+mesh=...)`` then runs unchanged: the SPMD programs this package builds are
+single-controller-per-host jit programs, exactly what multi-host JAX
+expects (SURVEY.md §2.5, §5 "Distributed communication backend").
+
+Typical pod usage (same program on every host):
+
+    import jax
+    from spark_ensemble_tpu.parallel import multihost, mesh
+
+    multihost.initialize()                    # auto-detect on Cloud TPU
+    # dcn_data = SLICE count (NOT host count: one slice may span several
+    # host processes, and the DCN axis groups by slice)
+    n_slices = len({getattr(d, "slice_index", 0) for d in jax.devices()})
+    m = mesh.hybrid_data_member_mesh(dcn_data=max(n_slices, 1))
+    model = GBMClassifier(...).fit(X_local, y_local, mesh=m)
+
+(Every process must pass the same global arrays / shardings; use
+``jax.make_array_from_process_local_data`` for per-host input pipelines.)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+# set once initialize() has joined (or decided to skip) the rendezvous —
+# jax.process_count() CANNOT serve as the guard, because calling it
+# instantiates the local backend, after which jax.distributed.initialize
+# refuses to run ("must be called before any JAX computations")
+_initialized = False
+
+
+def _already_distributed() -> bool:
+    """Whether the distributed client already exists, WITHOUT touching the
+    backend (the private global_state probe is the only pre-init check jax
+    offers; degrade to the module flag if it moves)."""
+    try:
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None) is not None
+    except Exception:  # noqa: BLE001 - private API may move between versions
+        return _initialized
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-process JAX rendezvous (idempotent; single-process
+    runs may skip this entirely).
+
+    With no arguments, environment auto-detection applies (Cloud TPU
+    metadata, or the ``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/
+    ``JAX_PROCESS_ID`` variables).  Explicit arguments mirror
+    ``jax.distributed.initialize`` — all three must be supplied together.
+    """
+    global _initialized
+    explicit = (coordinator_address, num_processes, process_id)
+    if any(v is not None for v in explicit) and any(
+        v is None for v in explicit
+    ):
+        raise ValueError(
+            "coordinator_address, num_processes, and process_id must be "
+            "passed together (or all omitted for auto-detection)"
+        )
+    if _initialized or _already_distributed():
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+
+
+def process_count() -> int:
+    """Number of host processes in the rendezvous (1 when single-process)."""
+    return jax.process_count()
+
+
+def process_index() -> int:
+    """This host's index (0 when single-process)."""
+    return jax.process_index()
+
+
+def local_device_count() -> int:
+    """Chips attached to THIS host (``jax.local_device_count()``)."""
+    return jax.local_device_count()

@@ -157,3 +157,21 @@ def test_hybrid_mesh_dcn_branch_config_errors_propagate():
     devs = [_FakeSliceDev(i, i // 4) for i in range(8)]  # 2 slices
     with pytest.raises(ValueError, match="slices"):
         hybrid_data_member_mesh(dcn_data=4, member=2, devices=devs)
+
+
+def test_multihost_single_process_contract():
+    """Single-process behavior of the multi-host entry point: helpers
+    report the degenerate topology, partial explicit args are rejected
+    before touching the rendezvous, and an already-initialized (or
+    single-process) state makes initialize a no-op path decision."""
+    import pytest
+
+    from spark_ensemble_tpu.parallel import multihost
+
+    assert multihost.process_count() == 1
+    assert multihost.process_index() == 0
+    assert multihost.local_device_count() >= 1
+    with pytest.raises(ValueError, match="together"):
+        multihost.initialize(coordinator_address="h:1234")
+    with pytest.raises(ValueError, match="together"):
+        multihost.initialize(num_processes=2, process_id=0)
