@@ -6,6 +6,7 @@ module.exports = {
       'boosting',
       'gbm',
       'stacking',
+      'selection',
       'example',
     ],
   },
