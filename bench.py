@@ -29,11 +29,19 @@ _METRIC = "GBM boosting-iters/sec/chip (letter)"
 
 # First driver-captured iters/sec per device platform (see BASELINE.md).
 # vs_baseline for later rounds = measured / baseline on the same platform.
+#
+# PROTOCOL NOTE (round 3): timed fits now block on the model params.  The
+# earlier protocol timed only dispatch — jax's async dispatch let fit()
+# return ~5.8x before the CPU device work finished (measured round 3), so
+# pre-round-3 captures are dispatch rates, not compute rates.  The CPU
+# baseline below is the first HONEST capture; the TPU baseline keeps the
+# round-2 (biased-fast) number until a real-chip capture replaces it —
+# meaning a future TPU vs_baseline UNDERSTATES the true improvement.
 _BASELINES = {
-    # round 2 driver capture (BENCH_r02.json), letter 20 rounds on CPU
-    "cpu": 13.033,
+    # round 3 blocking-protocol capture, letter 20 rounds on CPU
+    "cpu": 2.373,
     # round 2, TPU v5 lite, letter 100 rounds, newton+line-search
-    # (BASELINE.md "Measured" table)
+    # (BASELINE.md "Measured" table; pre-blocking protocol)
     "tpu": 6.991,
 }
 
