@@ -289,9 +289,7 @@ def _bench_full_extras():
             fn()  # warmup/compile
             t0 = _time.perf_counter()
             model = fn()
-            import jax as _jax
-
-            _jax.block_until_ready(_jax.tree_util.tree_leaves(model.params))
+            _block_on_model(model)
             out[name] = round(_time.perf_counter() - t0, 3)
         except Exception as e:  # noqa: BLE001 - carry the error, keep going
             out[name + "_error"] = str(e)[:200]
@@ -346,17 +344,44 @@ def _bench_large_extras():
         return {"large_error": str(e)[:200]}
 
 
+def _block_on_model(model):
+    """Block on EVERY jax array reachable from the fitted model — composite
+    models (stacking, pipelines) keep their arrays in base_models /
+    stack_model attributes, not .params, and blocking on .params alone
+    leaves their device work uncounted."""
+    import jax
+
+    seen = set()
+
+    def walk(obj):
+        if id(obj) in seen:
+            return
+        seen.add(id(obj))
+        if isinstance(obj, jax.Array):
+            obj.block_until_ready()
+        elif isinstance(obj, (list, tuple)):
+            for o in obj:
+                walk(o)
+        elif isinstance(obj, dict):
+            for o in obj.values():
+                walk(o)
+        elif hasattr(obj, "predict") and hasattr(obj, "__dict__"):
+            for o in vars(obj).values():
+                walk(o)
+
+    walk(model)
+
+
 def _timed_fit(est, X, y):
     """(model, seconds) with device work INCLUDED: every timed fit in this
-    file blocks on the model params so async dispatch cannot undercount —
-    one protocol for the headline, tier, and large-batch numbers."""
+    file blocks on the fitted model's reachable arrays so async dispatch
+    cannot undercount — one protocol for the headline, tier, large-batch,
+    and per-config numbers."""
     import time as _time
-
-    import jax
 
     t0 = _time.perf_counter()
     model = est.fit(X, y)
-    jax.block_until_ready(jax.tree_util.tree_leaves(model.params))
+    _block_on_model(model)
     return model, _time.perf_counter() - t0
 
 

@@ -68,6 +68,7 @@ from spark_ensemble_tpu.models.base import (
     as_f32,
     cached_program,
     infer_num_classes,
+    mesh_fit_kwargs,
     resolve_weights,
 )
 from spark_ensemble_tpu.models.dummy import DummyClassifier, DummyRegressor
@@ -287,13 +288,19 @@ class GBMRegressor(_GBMParams):
             return losses_mod.ScaledLogCoshLoss(self.alpha)
         return losses_mod.get_regression_loss(name)
 
-    def _fit_init(self, X, y, w):
-        """Init model (`GBMRegressor.scala:287-303`)."""
+    def _fit_init(self, X, y, w, mesh=None):
+        """Init model (`GBMRegressor.scala:287-303`); with ``mesh`` the init
+        fit distributes through the base learner's standalone mesh path —
+        no single-device island before the distributed rounds."""
         strategy = self.init_strategy.lower()
         if strategy == "base":
-            return self._base().fit(X, y, sample_weight=w)
+            base = self._base()
+            return base.fit(
+                X, y, sample_weight=w, **mesh_fit_kwargs(base, mesh)
+            )
         if strategy == "zero":
-            return DummyRegressor(strategy="constant", constant=0.0).fit(X, y, w)
+            dummy = DummyRegressor(strategy="constant", constant=0.0)
+            return dummy.fit(X, y, w, **mesh_fit_kwargs(dummy, mesh))
         name = self.loss.lower()
         if name in ("absolute", "huber"):
             dummy = DummyRegressor(strategy="median")
@@ -301,7 +308,9 @@ class GBMRegressor(_GBMParams):
             dummy = DummyRegressor(strategy="quantile", quantile=self.alpha)
         else:
             dummy = DummyRegressor(strategy="mean")
-        return dummy.fit(X, y, sample_weight=w)
+        return dummy.fit(
+            X, y, sample_weight=w, **mesh_fit_kwargs(dummy, mesh)
+        )
 
     @instrumented_fit
     def fit(self, X, y, sample_weight=None, validation_indicator=None, mesh=None):
@@ -331,7 +340,7 @@ class GBMRegressor(_GBMParams):
         ctx = base.make_fit_ctx(X)
         bag_keys, masks = self._sampling_plan(n, d)
 
-        init_model = self._fit_init(X, y, w)
+        init_model = self._fit_init(X, y, w, mesh=mesh)
         huber = self.loss.lower() == "huber"
         # initial huber delta: alpha-quantile of the label over the full
         # input (reference `GBMRegressor.scala:305-308` uses `dataset`)
@@ -810,8 +819,10 @@ class GBMClassifier(_GBMParams):
         # passed explicitly — the train split may be missing the top class
         # (validation indicator or CV fold), and the init prior must still
         # be K-dimensional
-        init_model = DummyClassifier(strategy=self.init_strategy).fit(
-            X, y, sample_weight=w, num_classes=num_classes
+        init_dummy = DummyClassifier(strategy=self.init_strategy)
+        init_model = init_dummy.fit(
+            X, y, sample_weight=w, num_classes=num_classes,
+            **mesh_fit_kwargs(init_dummy, mesh),
         )
         if dim == 1 and num_classes == 2 and self.init_strategy.lower() == "prior":
             # clamp BOTH sides: with explicit num_classes a train split can

@@ -35,6 +35,7 @@ from spark_ensemble_tpu.models.base import (
     RegressionModel,
     as_f32,
     infer_num_classes,
+    mesh_fit_kwargs,
     resolve_weights,
 )
 from spark_ensemble_tpu.models.linear import LinearRegression, LogisticRegression
@@ -144,7 +145,10 @@ class StackingRegressor(_StackingParams):
         w = resolve_weights(y, sample_weight)
         models = self._fit_bases(self._bases(), X, y, w, sample_weight, mesh=mesh)
         meta = jnp.stack([m.predict(X) for m in models], axis=1)  # [n, num_bases]
-        stack_model = self._stacker().fit(meta, y, sample_weight=w)
+        stacker = self._stacker()
+        stack_model = stacker.fit(
+            meta, y, sample_weight=w, **mesh_fit_kwargs(stacker, mesh)
+        )
         return StackingRegressionModel(
             base_models=models,
             stack_model=stack_model,
@@ -205,10 +209,11 @@ class StackingClassifier(_StackingParams):
         )
         meta = self._meta_features(models, X)
         stacker = self._stacker()
+        kw = mesh_fit_kwargs(stacker, mesh)
         stack_model = (
-            stacker.fit(meta, y, sample_weight=w, num_classes=num_classes)
+            stacker.fit(meta, y, sample_weight=w, num_classes=num_classes, **kw)
             if stacker.is_classifier
-            else stacker.fit(meta, y, sample_weight=w)
+            else stacker.fit(meta, y, sample_weight=w, **kw)
         )
         return StackingClassificationModel(
             base_models=models,
