@@ -599,3 +599,26 @@ def test_distributed_inference_via_sharded_inputs(mesh8):
     )
     # the output rides the input's sharding (no gather to one device)
     assert "data" in str(p_sharded.sharding.spec)
+
+
+def test_boosting_and_bagging_hybrid_mesh():
+    """Boosting and Bagging on the multi-slice hybrid mesh: rows shard over
+    BOTH data axes (("dcn_data", "data") psum/pmax; bagging's member axis
+    stays within a slice) — only GBM's hybrid leg was covered before."""
+    from spark_ensemble_tpu import BoostingRegressor
+    from spark_ensemble_tpu.parallel.mesh import hybrid_data_member_mesh
+
+    X, y = _reg_data()
+    mesh = hybrid_data_member_mesh(dcn_data=2, member=2)
+    cfg = dict(num_base_learners=4, loss="exponential", seed=5)
+    single = BoostingRegressor(**cfg).fit(X, y)
+    dist = BoostingRegressor(**cfg).fit(X, y, mesh=mesh)
+    assert single.num_members == dist.num_members
+    r_s, r_d = _rmse(single.predict(X), y), _rmse(dist.predict(X), y)
+    assert abs(r_s - r_d) < 0.03 * max(r_s, r_d) + 1e-6, (r_s, r_d)
+
+    bcfg = dict(num_base_learners=6, subsample_ratio=0.9, seed=6)
+    bs = BaggingRegressor(**bcfg).fit(X, y)
+    bd = BaggingRegressor(**bcfg).fit(X, y, mesh=mesh)
+    rb_s, rb_d = _rmse(bs.predict(X), y), _rmse(bd.predict(X), y)
+    assert abs(rb_s - rb_d) < 0.03 * max(rb_s, rb_d) + 1e-6, (rb_s, rb_d)
