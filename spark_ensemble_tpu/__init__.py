@@ -44,6 +44,12 @@ from spark_ensemble_tpu.models.linear import (
     LogisticRegression,
     LogisticRegressionModel,
 )
+from spark_ensemble_tpu.models.mlp import (
+    MLPClassificationModel,
+    MLPClassifier,
+    MLPRegressionModel,
+    MLPRegressor,
+)
 from spark_ensemble_tpu.models.naive_bayes import (
     GaussianNaiveBayes,
     GaussianNaiveBayesModel,
@@ -115,6 +121,10 @@ __all__ = [
     "LogisticRegressionModel",
     "GaussianNaiveBayes",
     "GaussianNaiveBayesModel",
+    "MLPClassifier",
+    "MLPClassificationModel",
+    "MLPRegressor",
+    "MLPRegressionModel",
     "RegressionEvaluator",
     "MulticlassClassificationEvaluator",
     "BinaryClassificationEvaluator",

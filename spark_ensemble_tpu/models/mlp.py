@@ -1,0 +1,235 @@
+"""Multilayer-perceptron base learners (classifier and regressor).
+
+The reference accepts ANY Spark ML ``Predictor`` as an ensemble member
+(existential base-learner types, `ensemble/package.scala:32-67`); Spark
+MLlib's ``MultilayerPerceptronClassifier`` is its stock nonlinear choice.
+This module is the TPU-native equivalent: a fixed-topology MLP whose fit is
+a pure, jittable, vmappable member of the BaseLearner protocol — a static
+count of full-batch Adam steps inside ``lax.scan`` (no data-dependent
+control flow, so members fuse under ``vmap`` and the program compiles
+once), weighted loss, features standardized internally.  The forward pass
+is back-to-back ``[n,h] @ [h,h']`` matmuls — MXU-shaped by construction,
+unlike the tree learners whose MXU mapping had to be designed (ops/tree.py).
+
+SPMD contract (``axis_name``): the fit computes SHARD-LOCAL per-example
+loss sums normalized by the GLOBAL (psum-ed) weight mass, then psums the
+gradient pytree explicitly — an objective that psums internally would
+yield shard-local gradients (the ``psum``-transpose trap documented at
+`ops/linesearch.py:130-138`).  The L2 term's gradient is added once AFTER
+the reduction so it is not multiplied by the shard count.  Every shard
+then applies the identical Adam update, mirroring how the reference's
+executors would each hold the same broadcast model between
+``treeAggregate`` passes (`GBMClassifier.scala:344-355`).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from spark_ensemble_tpu.models.base import (
+    BaseLearner,
+    ClassificationModel,
+    RegressionModel,
+    Static,
+    as_f32,
+    static_value,
+)
+from spark_ensemble_tpu.models.linear import _apply_mask, _feature_stats
+from spark_ensemble_tpu.ops.collective import preduce
+from spark_ensemble_tpu.params import Param, gt, gt_eq, in_array
+
+
+def _hidden_sizes_ok(v):
+    # a scalar (the sklearn-style `hidden_layer_sizes=64` spelling) must
+    # fail as an invalid value, not a TypeError from len()
+    if not isinstance(v, (list, tuple)):
+        return False
+    return len(v) >= 1 and all(int(h) == h and h >= 1 for h in v)
+
+
+class _MLPBase(BaseLearner):
+    hidden_layer_sizes = Param(
+        (64,),
+        _hidden_sizes_ok,
+        doc="widths of the hidden layers (static topology: part of the "
+        "compiled program's shape, like Spark MLP's `layers` param)",
+    )
+    activation = Param("relu", in_array(["relu", "tanh"]))
+    max_iter = Param(
+        200,
+        gt_eq(1),
+        doc="full-batch Adam steps; a STATIC count (lax.scan) so member "
+        "fits stay fusable — convergence-based stopping would make the "
+        "program shape data-dependent",
+    )
+    learning_rate_init = Param(1e-2, gt(0.0))
+    reg_param = Param(1e-4, gt_eq(0.0), doc="L2 penalty on weights (not biases)")
+    seed = Param(0)
+
+    def _sizes(self, d: int, out_dim: int):
+        return (d, *[int(h) for h in self.hidden_layer_sizes], out_dim)
+
+    def _act(self, z):
+        return jax.nn.relu(z) if self.activation == "relu" else jnp.tanh(z)
+
+    def _init_net(self, key, sizes):
+        layers = []
+        for fan_in, fan_out in zip(sizes[:-1], sizes[1:]):
+            key, sub = jax.random.split(key)
+            lim = math.sqrt(6.0 / (fan_in + fan_out))
+            layers.append(
+                {
+                    "W": jax.random.uniform(
+                        sub, (fan_in, fan_out), jnp.float32, -lim, lim
+                    ),
+                    "b": jnp.zeros((fan_out,), jnp.float32),
+                }
+            )
+        return layers
+
+    def _forward(self, layers, Xs):
+        h = Xs
+        for layer in layers[:-1]:
+            h = self._act(h @ layer["W"] + layer["b"])
+        return h @ layers[-1]["W"] + layers[-1]["b"]
+
+    def _train_net(self, Xs, w, key, out_dim, per_example_loss, axis_name):
+        """Adam on mean weighted loss; returns the trained layer pytree.
+
+        ``per_example_loss(out) -> [n]`` given the net output ``[n, out]``.
+        """
+        net0 = self._init_net(key, self._sizes(Xs.shape[1], out_dim))
+        wsum = jnp.maximum(preduce(jnp.sum(w), axis_name), 1e-30)
+        reg = jnp.float32(self.reg_param)
+
+        def local_obj(net):
+            # local weighted SUM over this shard's rows / GLOBAL weight
+            # mass; no psum inside (see module docstring)
+            return jnp.sum(w * per_example_loss(self._forward(net, Xs))) / wsum
+
+        opt = optax.adam(self.learning_rate_init)
+
+        def step(carry, _):
+            net, opt_state = carry
+            grads = jax.grad(local_obj)(net)
+            grads = jax.tree_util.tree_map(
+                lambda g: preduce(g, axis_name), grads
+            )
+            # L2 gradient added once, post-reduction (replicated params)
+            grads = [
+                {"W": g["W"] + reg * p["W"], "b": g["b"]}
+                for g, p in zip(grads, net)
+            ]
+            updates, opt_state = opt.update(grads, opt_state, net)
+            return (optax.apply_updates(net, updates), opt_state), None
+
+        (net, _), _ = jax.lax.scan(
+            step, (net0, opt.init(net0)), None, length=int(self.max_iter)
+        )
+        return net
+
+    def _prep(self, X, feature_mask, w, axis_name):
+        """Masked, standardized features + the stats/mask to store."""
+        Xm = _apply_mask(X, feature_mask)
+        mu, sd = _feature_stats(Xm, w, axis_name)
+        Xs = (Xm - mu[None, :]) / sd[None, :]
+        mask = (
+            feature_mask.astype(jnp.float32)
+            if feature_mask is not None
+            else jnp.ones((X.shape[1],), jnp.float32)
+        )
+        return Xs, {"x_mu": mu, "x_sd": sd, "mask": mask}
+
+    def _input(self, params, X):
+        Xm = X * params["mask"][None, :]
+        return (Xm - params["x_mu"][None, :]) / params["x_sd"][None, :]
+
+
+class MLPClassifier(_MLPBase):
+    is_classifier = True
+
+    def make_fit_ctx(self, X, num_classes: Optional[int] = None):
+        return {"X": as_f32(X), "num_classes": Static(num_classes)}
+
+    def fit_from_ctx(self, ctx, y, w, feature_mask, key, axis_name=None):
+        X = ctx["X"]
+        k = static_value(ctx["num_classes"])
+        Xs, stats = self._prep(X, feature_mask, w, axis_name)
+        onehot = jax.nn.one_hot(y.astype(jnp.int32), k)
+
+        def ce(logits):
+            return -jnp.sum(jax.nn.log_softmax(logits, axis=-1) * onehot, axis=-1)
+
+        layers = self._train_net(Xs, w, key, k, ce, axis_name)
+        return {"layers": layers, **stats}
+
+    def predict_raw_fn(self, params, X):
+        return self._forward(params["layers"], self._input(params, X))
+
+    def predict_proba_fn(self, params, X):
+        return jax.nn.softmax(self.predict_raw_fn(params, X), axis=-1)
+
+    def predict_fn(self, params, X):
+        return jnp.argmax(self.predict_raw_fn(params, X), axis=-1).astype(
+            jnp.float32
+        )
+
+    def model_from_params(self, params, num_features, num_classes=None):
+        return MLPClassificationModel(
+            params=params,
+            num_features=num_features,
+            num_classes=num_classes or 2,
+            **self.get_params(),
+        )
+
+
+class MLPClassificationModel(ClassificationModel, MLPClassifier):
+    def predict_raw(self, X):
+        return self.predict_raw_fn(self.params, as_f32(X))
+
+    def predict_proba(self, X):
+        return self.predict_proba_fn(self.params, as_f32(X))
+
+    def predict(self, X):
+        return self.predict_fn(self.params, as_f32(X))
+
+
+class MLPRegressor(_MLPBase):
+    is_classifier = False
+
+    def fit_from_ctx(self, ctx, y, w, feature_mask, key, axis_name=None):
+        X = ctx
+        Xs, stats = self._prep(X, feature_mask, w, axis_name)
+        # standardize the target too (weighted): raw-scale targets (e.g.
+        # cpusmall, magnitudes ~1e2) would force a per-dataset learning
+        # rate; predictions unscale through the stored moments
+        wsum = jnp.maximum(preduce(jnp.sum(w), axis_name), 1e-30)
+        y_mu = preduce(jnp.sum(w * y), axis_name) / wsum
+        y_var = preduce(jnp.sum(w * (y - y_mu) ** 2), axis_name) / wsum
+        y_sd = jnp.maximum(jnp.sqrt(y_var), 1e-7)
+        ys = (y - y_mu) / y_sd
+
+        def sq(out):
+            return 0.5 * (out[:, 0] - ys) ** 2
+
+        layers = self._train_net(Xs, w, key, 1, sq, axis_name)
+        return {"layers": layers, "y_mu": y_mu, "y_sd": y_sd, **stats}
+
+    def predict_fn(self, params, X):
+        out = self._forward(params["layers"], self._input(params, X))
+        return out[:, 0] * params["y_sd"] + params["y_mu"]
+
+    def model_from_params(self, params, num_features, num_classes=None):
+        return MLPRegressionModel(
+            params=params, num_features=num_features, **self.get_params()
+        )
+
+
+class MLPRegressionModel(RegressionModel, MLPRegressor):
+    def predict(self, X):
+        return self.predict_fn(self.params, as_f32(X))

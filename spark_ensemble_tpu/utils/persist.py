@@ -31,6 +31,7 @@ def _class_registry():
         dummy,
         gbm,
         linear,
+        mlp,
         naive_bayes,
         stacking,
         tree,
@@ -43,6 +44,7 @@ def _class_registry():
         dummy,
         gbm,
         linear,
+        mlp,
         naive_bayes,
         stacking,
         tree,

@@ -1,0 +1,185 @@
+"""MLP base-learner tests.
+
+The reference accepts any Spark ML ``Predictor`` as a member
+(`ensemble/package.scala:32-67`); Spark MLlib's
+``MultilayerPerceptronClassifier`` is its stock nonlinear base learner.
+These tests mirror the suite archetypes of SURVEY.md §4: beats-baseline
+(vs the linear learner on a linearly inseparable dataset), weighted-fit
+semantics, SPMD parity on the virtual mesh, ensemble composition, and
+persistence round-trip.
+"""
+
+import numpy as np
+import pytest
+
+from spark_ensemble_tpu import (
+    BaggingClassifier,
+    GBMRegressor,
+    LogisticRegression,
+    MLPClassifier,
+    MLPRegressor,
+    StackingClassifier,
+)
+from spark_ensemble_tpu.parallel.mesh import data_member_mesh
+from spark_ensemble_tpu.utils import persist
+
+
+def _rings(n=2000, seed=0):
+    """Two concentric rings: linearly inseparable by construction."""
+    rng = np.random.RandomState(seed)
+    r = np.where(rng.rand(n) < 0.5, 1.0, 2.5) + 0.1 * rng.randn(n)
+    th = rng.rand(n) * 2 * np.pi
+    X = np.stack([r * np.cos(th), r * np.sin(th)], 1).astype(np.float32)
+    y = (r > 1.75).astype(np.float32)
+    return X, y
+
+
+def _nonlinear_reg(n=1500, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 3).astype(np.float32)
+    y = (np.sin(X[:, 0]) + X[:, 1] ** 2 + 100.0).astype(np.float32)
+    return X, y
+
+
+def test_mlp_classifier_beats_linear_on_rings():
+    X, y = _rings()
+    mlp_acc = float(
+        np.mean(np.asarray(MLPClassifier(max_iter=300).fit(X, y).predict(X)) == y)
+    )
+    lr_acc = float(
+        np.mean(np.asarray(LogisticRegression().fit(X, y).predict(X)) == y)
+    )
+    assert mlp_acc > 0.95
+    assert lr_acc < 0.65  # the dataset is linearly inseparable
+    assert mlp_acc > lr_acc + 0.3
+
+
+def test_mlp_regressor_fits_nonlinear_target():
+    X, y = _nonlinear_reg()
+    m = MLPRegressor(max_iter=400).fit(X, y)
+    rmse = float(np.sqrt(np.mean((np.asarray(m.predict(X)) - y) ** 2)))
+    const = float(np.sqrt(np.mean((y - y.mean()) ** 2)))
+    assert rmse < 0.5 * const
+
+
+def test_mlp_multiclass_probabilities():
+    rng = np.random.RandomState(3)
+    n, k = 1200, 4
+    X = rng.randn(n, 5).astype(np.float32)
+    centers = rng.randn(k, 5).astype(np.float32)
+    y = np.argmax(X @ centers.T, axis=1).astype(np.float32)
+    m = MLPClassifier(max_iter=250).fit(X, y)
+    proba = np.asarray(m.predict_proba(X))
+    assert proba.shape == (n, k)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+    acc = float(np.mean(np.asarray(m.predict(X)) == y))
+    assert acc > 0.9
+
+
+def test_mlp_zero_weight_rows_do_not_affect_fit():
+    """Zero-weight rows are invisible (the padding/out-of-bag contract
+    every BaseLearner honors)."""
+    X, y = _rings(800)
+    rng = np.random.RandomState(1)
+    X_noise = rng.randn(200, 2).astype(np.float32) * 10
+    y_noise = rng.randint(0, 2, 200).astype(np.float32)
+    est = MLPClassifier(max_iter=120, hidden_layer_sizes=(16,))
+    m_clean = est.fit(X, y)
+    m_padded = est.fit(
+        np.concatenate([X, X_noise]),
+        np.concatenate([y, y_noise]),
+        sample_weight=np.concatenate(
+            [np.ones(len(X)), np.zeros(200)]
+        ).astype(np.float32),
+    )
+    p1 = np.asarray(m_clean.predict_proba(X))
+    p2 = np.asarray(m_padded.predict_proba(X))
+    # identical data views up to f32 reduction order in the feature stats
+    np.testing.assert_allclose(p1, p2, atol=1e-3)
+
+
+def test_mlp_feature_mask_equals_zeroed_columns():
+    """Fitting with a subspace mask == fitting on X with masked columns
+    zeroed (the reference's slice-projection semantics,
+    `HasSubBag.scala:81-84`)."""
+    import jax
+    import jax.numpy as jnp
+
+    X, y = _rings(600)
+    X3 = np.concatenate([X, np.random.RandomState(5).randn(600, 1)], 1).astype(
+        np.float32
+    )
+    est = MLPClassifier(max_iter=100, hidden_layer_sizes=(8,))
+    ctx = est.make_fit_ctx(jnp.asarray(X3), 2)
+    w = jnp.ones((600,))
+    key = jax.random.PRNGKey(0)
+    mask = jnp.asarray([1.0, 1.0, 0.0])
+    p_masked = est.fit_from_ctx(ctx, jnp.asarray(y), w, mask, key)
+    X0 = X3.copy()
+    X0[:, 2] = 0.0
+    ctx0 = est.make_fit_ctx(jnp.asarray(X0), 2)
+    p_zeroed = est.fit_from_ctx(ctx0, jnp.asarray(y), w, mask, key)
+    r1 = np.asarray(est.predict_raw_fn(p_masked, jnp.asarray(X3)))
+    r2 = np.asarray(est.predict_raw_fn(p_zeroed, jnp.asarray(X0)))
+    np.testing.assert_allclose(r1, r2, atol=1e-4)
+
+
+@pytest.mark.slow
+def test_mlp_mesh_fit_matches_single_device():
+    """Standalone distributed fit: rows sharded over "data", gradients
+    psum-ed — same model as single-device up to f32 reduction order."""
+    rng = np.random.RandomState(0)
+    n = 1003  # non-multiple of the data axis: exercises padding
+    X = rng.randn(n, 4).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] > 0).astype(np.float32)
+    est = MLPClassifier(max_iter=80, hidden_layer_sizes=(16,))
+    p1 = np.asarray(est.fit(X, y).predict_proba(X))
+    p2 = np.asarray(
+        est.fit(X, y, mesh=data_member_mesh(8, member=2)).predict_proba(X)
+    )
+    np.testing.assert_allclose(p1, p2, atol=5e-3)
+
+
+def test_mlp_as_ensemble_member():
+    X, y = _rings(1200)
+    bag = BaggingClassifier(
+        base_learner=MLPClassifier(max_iter=100, hidden_layer_sizes=(16,)),
+        num_base_learners=4,
+    ).fit(X, y)
+    assert float(np.mean(np.asarray(bag.predict(X)) == y)) > 0.9
+
+    st = StackingClassifier(
+        base_learners=[
+            MLPClassifier(max_iter=100, hidden_layer_sizes=(16,)),
+            LogisticRegression(),
+        ],
+        stacker=LogisticRegression(),
+    ).fit(X, y)
+    assert float(np.mean(np.asarray(st.predict(X)) == y)) > 0.9
+
+
+def test_mlp_as_gbm_base_learner():
+    rng = np.random.RandomState(0)
+    X = rng.randn(1000, 4).astype(np.float32)
+    y = (np.sin(X[:, 0]) + X[:, 1] ** 2).astype(np.float32)
+    g = GBMRegressor(
+        base_learner=MLPRegressor(max_iter=60, hidden_layer_sizes=(8,)),
+        num_base_learners=3,
+        learning_rate=0.5,
+    ).fit(X, y)
+    rmse = float(np.sqrt(np.mean((np.asarray(g.predict(X)) - y) ** 2)))
+    const = float(np.sqrt(np.mean((y - y.mean()) ** 2)))
+    assert rmse < 0.7 * const
+
+
+def test_mlp_persist_round_trip(tmp_path):
+    X, y = _rings(600)
+    m = MLPClassifier(max_iter=80, hidden_layer_sizes=(8,)).fit(X, y)
+    m.save(str(tmp_path / "m"))
+    m2 = persist.load(str(tmp_path / "m"))
+    np.testing.assert_allclose(
+        np.asarray(m2.predict_proba(X)), np.asarray(m.predict_proba(X))
+    )
+    # hidden_layer_sizes round-trips through JSON as a list; the topology
+    # must still match
+    assert tuple(m2.hidden_layer_sizes) == tuple(m.hidden_layer_sizes)
