@@ -96,11 +96,14 @@ class _BoostingParams(CheckpointableParams, Estimator):
     scan_chunk = Param(
         16,
         gt_eq(1),
-        doc="rounds fused into one lax.scan-ed XLA program per dispatch; "
+        doc="max rounds fused into one lax.scan-ed XLA program per dispatch; "
         "the data-dependent aborts (SAMME err >= 1-1/K, Drucker "
         "est_err >= 0.5, zero weight mass, perfect fit) are replayed on the "
         "host after each chunk, reproducing the per-round stopping exactly "
-        "(post-stop rounds in the chunk are discarded)",
+        "(post-stop rounds in the chunk are discarded).  Abort-prone "
+        "flavors ramp the chunk geometrically up to this cap so an early "
+        "abort discards at most ~the rounds already kept "
+        "(see _drive_boosting_rounds)",
     )
     checkpoint_interval = Param(10, gt_eq(1))
     checkpoint_dir = Param(
@@ -124,17 +127,31 @@ class _BoostingParams(CheckpointableParams, Estimator):
         run_chunk,  # (keys [c,2], bw) -> (params [c,...], est_ws [c], sum_bws [c], bw, extras)
         replay,  # (extras, sum_bws, c, i) -> (#rounds kept, stop?)
         start_i: int,
+        ramp: bool = False,
     ) -> int:
         """Shared chunked round driver for both boosting flavors: chunk
         clamping to checkpoint boundaries, per-chunk key fan-out, host
         replay of the flavor's stopping rules, slice-append of kept rounds,
         and gated periodic saves.  Mutates the chunk lists; returns the
-        final round count."""
+        final round count.
+
+        ``ramp``: abort-prone flavors (discrete SAMME, Drucker R2 — their
+        stopping rules fire routinely on weak learners) grow the chunk
+        geometrically 1, 2, 4, ... up to ``scan_chunk``.  An abort ends the
+        fit and discards the rest of the in-flight chunk, so a fixed chunk
+        wastes up to ``scan_chunk - 1`` base fits on the final dispatch;
+        the ramp bounds the discarded work by the work kept while adding
+        only ~log2(scan_chunk) extra dispatches to long abort-free runs.
+        SAMME.R has no error-threshold abort, so it keeps the fixed chunk."""
         i = start_i
         chunk = max(int(self.scan_chunk), 1)
+        # a checkpoint resume starts at the full chunk: start_i kept rounds
+        # already outweigh the worst-case discard of one fixed-size chunk
+        cur = 1 if (ramp and start_i == 0) else chunk
         stop = float(jnp.sum(bw)) <= 0
         while i < self.num_base_learners and not stop:
-            c = min(chunk, self.num_base_learners - i)
+            c = min(cur, self.num_base_learners - i)
+            cur = min(cur * 2, chunk)
             if ckpt.enabled:
                 c = min(c, ckpt.rounds_until_save(i))
             keys = jax.vmap(lambda j: jax.random.fold_in(root, j))(
@@ -333,7 +350,8 @@ class BoostingClassifier(_BoostingParams):
             logger.info("BoostingClassifier resuming from round %d", i)
 
         self._drive_boosting_rounds(
-            ckpt, bw, root, members_chunks, weights_chunks, run_chunk, replay, i
+            ckpt, bw, root, members_chunks, weights_chunks, run_chunk, replay,
+            i, ramp=(algorithm == "discrete"),
         )
         ckpt.delete()
         num_members = int(sum(wc.shape[0] for wc in weights_chunks))
@@ -584,7 +602,8 @@ class BoostingRegressor(_BoostingParams):
             logger.info("BoostingRegressor resuming from round %d", i)
 
         self._drive_boosting_rounds(
-            ckpt, bw, root, members_chunks, weights_chunks, run_chunk, replay, i
+            ckpt, bw, root, members_chunks, weights_chunks, run_chunk, replay,
+            i, ramp=True,
         )
         ckpt.delete()
         num_members = int(sum(wc.shape[0] for wc in weights_chunks))

@@ -146,3 +146,54 @@ def test_boosting_scan_chunk_invariance(letter, cpusmall):
         np.asarray(regs[1].predict(Xr[:200])),
         rtol=1e-5, atol=1e-5,
     )
+
+
+class _SpyBoostingClassifier(se.BoostingClassifier):
+    """Records the chunk sizes the round driver dispatches."""
+
+    def _drive_boosting_rounds(self, ckpt, bw, root, mc, wc, run_chunk,
+                               replay, start_i, ramp=False):
+        self.dispatched = []
+
+        def spy(keys, bw):
+            self.dispatched.append(int(keys.shape[0]))
+            return run_chunk(keys, bw)
+
+        return super()._drive_boosting_rounds(
+            ckpt, bw, root, mc, wc, spy, replay, start_i, ramp=ramp
+        )
+
+
+def test_boosting_chunk_ramp_schedule(letter):
+    """Abort-prone discrete SAMME ramps the chunk 1, 2, 4, ... up to
+    scan_chunk; SAMME.R (no error-threshold abort) keeps the fixed chunk."""
+    X, y = letter
+    Xs, ys = X[:1500], y[:1500]
+    disc = _SpyBoostingClassifier(
+        num_base_learners=10, scan_chunk=16, seed=2
+    )
+    disc.fit(Xs, ys)
+    assert disc.dispatched == [1, 2, 4, 3], disc.dispatched
+    real = _SpyBoostingClassifier(
+        algorithm="real", num_base_learners=10, scan_chunk=16, seed=2
+    )
+    real.fit(Xs, ys)
+    assert real.dispatched[0] == 10
+
+
+def test_boosting_ramp_bounds_discarded_work_on_early_abort():
+    """A constant-prediction base learner on skewed labels has weighted
+    error 0.8 >= 1 - 1/K, so discrete SAMME aborts on the very first
+    round; the ramp's first chunk is a single round, so exactly one base
+    fit is dispatched (a fixed scan_chunk=16 would have dispatched and
+    discarded 16)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, 3).astype(np.float32)
+    y = (rng.rand(400) < 0.8).astype(np.float32)  # class 1 dominates
+    est = _SpyBoostingClassifier(
+        base_learner=se.DummyClassifier(strategy="constant", constant=0),
+        num_base_learners=16, scan_chunk=16, seed=0,
+    )
+    m = est.fit(X, y)
+    assert est.dispatched == [1], est.dispatched
+    assert m.num_members == 0
