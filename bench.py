@@ -348,28 +348,12 @@ def _block_on_model(model):
     """Block on EVERY jax array reachable from the fitted model — composite
     models (stacking, pipelines) keep their arrays in base_models /
     stack_model attributes, not .params, and blocking on .params alone
-    leaves their device work uncounted."""
-    import jax
+    leaves their device work uncounted.  One walker shared with the
+    profile-trace hook so bench timing and trace capture can never disagree
+    about when device work is complete."""
+    from spark_ensemble_tpu.utils.instrumentation import block_on_arrays
 
-    seen = set()
-
-    def walk(obj):
-        if id(obj) in seen:
-            return
-        seen.add(id(obj))
-        if isinstance(obj, jax.Array):
-            obj.block_until_ready()
-        elif isinstance(obj, (list, tuple)):
-            for o in obj:
-                walk(o)
-        elif isinstance(obj, dict):
-            for o in obj.values():
-                walk(o)
-        elif hasattr(obj, "predict") and hasattr(obj, "__dict__"):
-            for o in vars(obj).values():
-                walk(o)
-
-    walk(model)
+    block_on_arrays(model)
 
 
 def _timed_fit(est, X, y):

@@ -78,9 +78,43 @@ def instrumented_fit(fit):
 
     @functools.wraps(fit)
     def wrapper(self, *args, **kwargs):
+        profile_dir = getattr(self, "profile_dir", None)
         with instrumented(f"{type(self).__name__}.fit"), profile_trace(
-            getattr(self, "profile_dir", None)
+            profile_dir
         ):
-            return fit(self, *args, **kwargs)
+            result = fit(self, *args, **kwargs)
+            if profile_dir:
+                # jax dispatch is async: without blocking here the trace
+                # would stop at dispatch time and capture none of the
+                # device execution (fit() keeps its async semantics when
+                # not profiling)
+                block_on_arrays(result)
+            return result
 
     return wrapper
+
+
+def block_on_arrays(obj) -> None:
+    """Block on every jax array reachable from ``obj`` (fitted models keep
+    arrays under .params but composites nest child models in attributes)."""
+    import jax
+
+    seen = set()
+
+    def walk(o):
+        if id(o) in seen:
+            return
+        seen.add(id(o))
+        if isinstance(o, jax.Array):
+            o.block_until_ready()
+        elif isinstance(o, (list, tuple)):
+            for x in o:
+                walk(x)
+        elif isinstance(o, dict):
+            for x in o.values():
+                walk(x)
+        elif hasattr(o, "predict") and hasattr(o, "__dict__"):
+            for x in vars(o).values():
+                walk(x)
+
+    walk(obj)

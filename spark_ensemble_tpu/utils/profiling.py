@@ -70,7 +70,20 @@ def summarize_events(
     for e in events:
         name = e.get("name", "?")
         if device_only and (
-            name.startswith(("$", "Thread", "process_"))
+            name.startswith(
+                (
+                    "$",
+                    "Thread",
+                    "process_",
+                    # host-side dispatch/runtime lanes, not device ops —
+                    # they overlap (and double-count) the device time they
+                    # wait on
+                    "PjitFunction(",
+                    "PjRt",
+                    "ThunkExecutor",
+                    "DevicePut",
+                )
+            )
             or "python" in name.lower()
         ):
             continue

@@ -74,3 +74,36 @@ def test_bagging_reproducible_with_seed(cpusmall):
     a = se.BaggingRegressor(num_base_learners=3, seed=7).fit(X, y)
     b = se.BaggingRegressor(num_base_learners=3, seed=7).fit(X, y)
     assert np.allclose(np.asarray(a.predict(X[:100])), np.asarray(b.predict(X[:100])))
+
+
+def test_member_plan_bit_identical_to_eager_loop():
+    """The one-program member plan must reproduce the eager draw tree
+    exactly (seed+i discipline, `BaggingRegressor.scala:141-143`)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_ensemble_tpu.utils.random import bootstrap_weights, subspace_mask
+
+    est = se.BaggingRegressor(
+        num_base_learners=6, subsample_ratio=0.8, subspace_ratio=0.5, seed=4
+    )
+    w = jnp.arange(1.0, 51.0)
+    fit_w, masks, keys = est._member_plan(50, 7, w)
+    root = jax.random.PRNGKey(4)
+    for i in [0, 2, 5]:
+        key = jax.random.fold_in(root, i)
+        np.testing.assert_array_equal(
+            np.asarray(
+                bootstrap_weights(jax.random.fold_in(key, 0), 50, True, 0.8)
+            )
+            * np.asarray(w),
+            np.asarray(fit_w[i]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(subspace_mask(jax.random.fold_in(key, 1), 7, 0.5)),
+            np.asarray(masks[i]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(jax.random.key_data(key)),
+            np.asarray(jax.random.key_data(keys[i])),
+        )

@@ -249,3 +249,27 @@ def test_gbm_with_linear_base_learner():
         base_learner=se.LinearRegression(), num_base_learners=3, loss="logloss"
     ).fit(X, yc)
     assert accuracy(mc.predict(X), yc) > 0.9
+
+
+def test_sampling_plan_bit_identical_to_eager_loop():
+    """The one-program sampling plan must reproduce the reference-mirroring
+    eager draw tree exactly (`GBMRegressor.scala:282-284` seed discipline):
+    per member i, mask = subspace_mask(fold_in(fold_in(root, i), 1)) and
+    bag key = fold_in(fold_in(root, i), 2)."""
+    import jax
+
+    from spark_ensemble_tpu.utils.random import subspace_mask
+
+    est = se.GBMRegressor(num_base_learners=9, subspace_ratio=0.6, seed=7)
+    bag_keys, masks = est._sampling_plan(100, 11)
+    root = jax.random.PRNGKey(7)
+    for i in [0, 3, 8]:
+        k = jax.random.fold_in(root, i)
+        np.testing.assert_array_equal(
+            np.asarray(subspace_mask(jax.random.fold_in(k, 1), 11, 0.6)),
+            np.asarray(masks[i]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(jax.random.key_data(jax.random.fold_in(k, 2))),
+            np.asarray(jax.random.key_data(bag_keys[i])),
+        )
