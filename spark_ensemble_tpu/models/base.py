@@ -185,6 +185,47 @@ class Model(Params):
 
         return FeatureMetadata.resolve(self.feature_names, self.num_features)
 
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        """Gain-based feature importances, normalized to sum 1 — Spark
+        ``TreeEnsembleModel.featureImportances`` semantics (the reference's
+        users read it off their Spark base models): each member tree's
+        gains are normalized to sum 1 FIRST, members average with equal
+        weight, and the average is renormalized.  Per-member normalization
+        matters for boosting/GBM, where raw gains decay geometrically with
+        the shrinking residuals — summing raw gains would reduce to the
+        first round's view.  Members with no realized split are skipped;
+        an all-leaf model returns zeros.  Raises AttributeError for base
+        learners without an impurity-gain notion (linear, NB, MLP, dummy)."""
+        gains = np.asarray(self._feature_gains_raw(), np.float64)
+        gains = gains.reshape(-1, gains.shape[-1])
+        sums = gains.sum(axis=1, keepdims=True)
+        active = sums[:, 0] > 0
+        if not active.any():
+            return np.zeros(gains.shape[-1])
+        imp = (gains[active] / sums[active]).mean(axis=0)
+        return imp / imp.sum()
+
+    def _feature_gains_raw(self):
+        """Raw (unnormalized) gains: ensemble models reach through their
+        stacked members via the base learner's ``feature_gains_fn``;
+        standalone learner models ARE their learner."""
+        if isinstance(self.params, dict) and "members" in self.params:
+            members = self.params["members"]
+            if members is None:  # zero kept rounds/members
+                return np.zeros((self.num_features,))
+            return self._base().feature_gains_fn(members, self.num_features)
+        gains_fn = getattr(self, "feature_gains_fn", None)
+        if gains_fn is None:
+            # e.g. stacking models: heterogeneous members each carry their
+            # own importances (query model.base_models[i] directly)
+            raise AttributeError(
+                f"{type(self).__name__} has no feature gains (gain-based "
+                "importances exist for tree base learners only; for "
+                "stacking, read them off the individual base_models)"
+            )
+        return gains_fn(self.params, self.num_features)
+
     def member_feature_names(self, i: int):
         """Feature names of member ``i``'s subspace — the reference
         re-indexes column metadata after ``slice()`` the same way."""
@@ -431,6 +472,15 @@ class BaseLearner(Estimator):
 
     def predict_proba_fn(self, params: Any, X: jax.Array) -> jax.Array:
         raise NotImplementedError
+
+    def feature_gains_fn(self, params: Any, d: int) -> jax.Array:
+        """Per-feature split-gain sums ``f32[..., d]`` (stacked members keep
+        their leading axes).  Only learners with an impurity-gain notion
+        (trees) implement this; it feeds ``Model.feature_importances_``."""
+        raise AttributeError(
+            f"{type(self).__name__} has no feature gains (gain-based "
+            "importances exist for tree base learners only)"
+        )
 
     def model_from_params(
         self, params: Any, num_features: int, num_classes: Optional[int] = None

@@ -25,6 +25,7 @@ from spark_ensemble_tpu.models.base import (
 from spark_ensemble_tpu.ops.binning import bin_features, compute_bins
 from spark_ensemble_tpu.ops.tree import (
     Tree,
+    feature_gains,
     fit_forest,
     fit_tree,
     predict_forest,
@@ -100,6 +101,9 @@ class _TreeLearner(BaseLearner):
             "thresholds": P(),
             "num_classes": ctx["num_classes"],
         }
+
+    def feature_gains_fn(self, params: Tree, d: int):
+        return feature_gains(params, d)
 
 
 class DecisionTreeRegressor(_TreeLearner):

@@ -57,6 +57,20 @@ class Tree(NamedTuple):
     split_bin: jax.Array  # i32[2^depth - 1]; max_bins-1 encodes "always left"
     split_threshold: jax.Array  # f32[2^depth - 1]; +inf encodes "always left"
     leaf_value: jax.Array  # f32[2^depth, k]
+    # f32[2^depth - 1] impurity gain of each realized split (0 at no-split
+    # sentinels) — feeds gain-based feature importances, the TPU analogue
+    # of Spark tree models' `featureImportances`
+    split_gain: jax.Array
+
+    @classmethod
+    def _persist_defaults(cls, fields: dict) -> dict:
+        """Persistence format evolution (consulted by ``persist._decode``):
+        ``split_gain`` was added in round 3 — saves made before it load
+        with zero gains (predictions unaffected; importances degrade to
+        zeros)."""
+        if "split_gain" not in fields and "split_threshold" in fields:
+            fields["split_gain"] = jnp.zeros_like(fields["split_threshold"])
+        return fields
 
     @property
     def depth(self) -> int:
@@ -202,6 +216,7 @@ def fit_tree(
     split_feature = jnp.zeros((num_internal,), jnp.int32)
     split_bin = jnp.zeros((num_internal,), jnp.int32)
     split_threshold = jnp.zeros((num_internal,), jnp.float32)
+    split_gain = jnp.zeros((num_internal,), jnp.float32)
 
     node = jnp.zeros((n,), jnp.int32)  # node-local index within current level
     parent_value = y_mean[None, :]  # [1, k] fallback values, updated per level
@@ -275,6 +290,9 @@ def fit_tree(
         split_feature = split_feature.at[heap].set(best_f)
         split_bin = split_bin.at[heap].set(best_t)
         split_threshold = split_threshold.at[heap].set(thr)
+        split_gain = split_gain.at[heap].set(
+            jnp.where(do_split, best_gain, 0.0)
+        )
 
         # ---- route rows to children; update fallback values ---------------
         if hist == "matmul":
@@ -336,7 +354,26 @@ def fit_tree(
         split_bin=split_bin,
         split_threshold=split_threshold,
         leaf_value=leaf_value + y_mean[None, :],
+        split_gain=split_gain,
     )
+
+
+def feature_gains(trees: Tree, d: int) -> jax.Array:
+    """Per-feature summed split gains ``f32[..., d]`` for a single tree or
+    a stacked-member Tree pytree (any leading batch dims).
+
+    No-split sentinel nodes carry gain 0 (and feature 0), so they
+    contribute nothing.  Feeds gain-based ``feature_importances_`` — the
+    analogue of Spark tree models' ``featureImportances`` (which the
+    reference's users get from their Spark base models)."""
+    sf = trees.split_feature
+    sg = trees.split_gain
+    flat_f = sf.reshape(-1, sf.shape[-1])
+    flat_g = sg.reshape(-1, sg.shape[-1])
+    out = jax.vmap(
+        lambda f, g: jnp.zeros((d,), jnp.float32).at[f].add(g)
+    )(flat_f, flat_g)
+    return out.reshape(sf.shape[:-1] + (d,))
 
 
 # fused-forest A-matrix budget: n * M * nodes * (1+k) cells at the deepest
@@ -434,6 +471,7 @@ def fit_forest(
     split_feature = jnp.zeros((M, num_internal), jnp.int32)
     split_bin = jnp.zeros((M, num_internal), jnp.int32)
     split_threshold = jnp.zeros((M, num_internal), jnp.float32)
+    split_gain = jnp.zeros((M, num_internal), jnp.float32)
 
     node = jnp.zeros((n, M), jnp.int32)  # node-local index within the level
     parent_value = y_mean[:, None, :]  # [M, 1, k]
@@ -491,6 +529,9 @@ def fit_forest(
         split_feature = split_feature.at[:, heap].set(best_f)
         split_bin = split_bin.at[:, heap].set(best_t)
         split_threshold = split_threshold.at[:, heap].set(thr)
+        split_gain = split_gain.at[:, heap].set(
+            jnp.where(do_split, best_gain, 0.0)
+        )
 
         # ---- route rows to children (all members at once) -----------------
         # gather-free (see fit_tree): contract the node one-hot against the
@@ -536,6 +577,7 @@ def fit_forest(
         split_bin=split_bin,
         split_threshold=split_threshold,
         leaf_value=leaf_value + y_mean[:, None, :],
+        split_gain=split_gain,
     )
 
 

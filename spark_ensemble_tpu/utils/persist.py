@@ -104,9 +104,24 @@ def _decode(spec: Any, arrays, registry):
         return jnp.asarray(arrays[spec["__array__"]])
     if "__namedtuple__" in spec:
         cls = registry[spec["__namedtuple__"]]
-        return cls(
-            **{k: _decode(v, arrays, registry) for k, v in spec["fields"].items()}
-        )
+        fields = {
+            k: _decode(v, arrays, registry) for k, v in spec["fields"].items()
+        }
+        missing = [f for f in getattr(cls, "_fields", ()) if f not in fields]
+        if missing:
+            # format evolution: classes declare defaults for fields added
+            # after artifacts were saved (e.g. Tree._persist_defaults);
+            # the decoder itself stays schema-agnostic
+            defaults_hook = getattr(cls, "_persist_defaults", None)
+            if defaults_hook is not None:
+                fields = defaults_hook(fields)
+            still = [f for f in cls._fields if f not in fields]
+            if still:
+                raise ValueError(
+                    f"saved {spec['__namedtuple__']} is missing fields "
+                    f"{still!r} and declares no defaults for them"
+                )
+        return cls(**fields)
     if "__dict__" in spec:
         return {k: _decode(v, arrays, registry) for k, v in spec["__dict__"].items()}
     if "__list__" in spec:

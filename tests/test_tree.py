@@ -538,3 +538,144 @@ def test_fast_tier_matmul_prefix_sums_metric_parity():
         r_e = float(np.sqrt(np.mean((pe[m, :, 0] - y) ** 2)))
         r_f = float(np.sqrt(np.mean((pf[m, :, 0] - y) ** 2)))
         assert abs(r_e - r_f) < 0.03 * max(r_e, r_f) + 1e-6, (m, r_e, r_f)
+
+
+def test_feature_importances_gain_based():
+    """Gain-based importances (Spark `featureImportances` analogue): the
+    only informative feature dominates; normalized to sum 1; members
+    aggregate across every tree-backed ensemble family; gains survive
+    persistence; non-tree learners raise."""
+    import spark_ensemble_tpu as se
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(1500, 6).astype(np.float32)
+    y = (X[:, 2] + 0.1 * rng.randn(1500)).astype(np.float32)
+    yk = (X[:, 2] > 0).astype(np.float32)
+
+    t = se.DecisionTreeRegressor(max_depth=4).fit(X, y)
+    fi = t.feature_importances_
+    assert fi.shape == (6,)
+    assert abs(fi.sum() - 1.0) < 1e-9
+    assert fi[2] > 0.9
+
+    for model in (
+        se.GBMRegressor(num_base_learners=4).fit(X, y),
+        se.BaggingClassifier(num_base_learners=4).fit(X, yk),
+        se.BoostingClassifier(num_base_learners=3).fit(X, yk),
+        se.GBMClassifier(num_base_learners=3).fit(X, yk),
+    ):
+        efi = model.feature_importances_
+        assert abs(efi.sum() - 1.0) < 1e-9, type(model).__name__
+        assert efi[2] == efi.max(), (type(model).__name__, efi)
+
+    import pytest as _pytest
+
+    with _pytest.raises(AttributeError):
+        se.MLPClassifier(max_iter=5).fit(X, yk).feature_importances_
+
+
+def test_feature_importances_persist_round_trip(tmp_path):
+    import spark_ensemble_tpu as se
+    from spark_ensemble_tpu.utils import persist
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(600, 5).astype(np.float32)
+    y = (2.0 * X[:, 1] - X[:, 3] + 0.1 * rng.randn(600)).astype(np.float32)
+    m = se.GBMRegressor(num_base_learners=3).fit(X, y)
+    m.save(str(tmp_path / "m"))
+    m2 = persist.load(str(tmp_path / "m"))
+    np.testing.assert_allclose(
+        m2.feature_importances_, m.feature_importances_
+    )
+
+
+def test_fit_tree_gain_paths_agree():
+    """split_gain parity between the scatter and matmul histogram paths
+    (same invariant as the split tables themselves)."""
+    rng = np.random.RandomState(3)
+    n, d = 1200, 5
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + 0.05 * rng.randn(n)).astype(np.float32)
+    b = compute_bins(jnp.asarray(X), 32)
+    Xb = bin_features(jnp.asarray(X), b)
+    w = jnp.ones((n,))
+    kw = dict(max_depth=4, max_bins=32)
+    t_s = fit_tree(Xb, jnp.asarray(y)[:, None], w, b.thresholds, hist="scatter", **kw)
+    t_m = fit_tree(Xb, jnp.asarray(y)[:, None], w, b.thresholds, hist="matmul", **kw)
+    np.testing.assert_allclose(
+        np.asarray(t_s.split_gain), np.asarray(t_m.split_gain), rtol=1e-4
+    )
+    assert float(np.asarray(t_s.split_gain).max()) > 0
+
+
+def test_load_pre_split_gain_tree_saves(tmp_path):
+    """Saves made before Tree grew split_gain (round 3) must still load:
+    the missing field decodes as zero gains (predictions unaffected,
+    importances degrade to zeros)."""
+    import json
+    import os
+
+    import spark_ensemble_tpu as se
+    from spark_ensemble_tpu.utils import persist
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 4).astype(np.float32)
+    y = (X[:, 1] + 0.1 * rng.randn(300)).astype(np.float32)
+    m = se.DecisionTreeRegressor(max_depth=3).fit(X, y)
+    path = str(tmp_path / "m")
+    m.save(path)
+
+    # rewrite the artifact as the OLD format: drop the split_gain field
+    # from the spec and its array from the npz
+    meta = json.load(open(os.path.join(path, "metadata.json")))
+
+    def strip(spec):
+        if isinstance(spec, dict):
+            if "__namedtuple__" in spec:
+                spec["fields"].pop("split_gain", None)
+            for v in spec.values():
+                strip(v)
+        elif isinstance(spec, list):
+            for v in spec:
+                strip(v)
+
+    strip(meta.get("learned", {}))
+    json.dump(meta, open(os.path.join(path, "metadata.json"), "w"))
+
+    m2 = persist.load(path)
+    np.testing.assert_allclose(
+        np.asarray(m2.predict(X)), np.asarray(m.predict(X))
+    )
+    assert float(np.sum(m2.feature_importances_)) == 0.0
+
+
+def test_feature_importances_normalize_per_member():
+    """Spark TreeEnsembleModel semantics: member trees are normalized
+    BEFORE averaging, so late GBM rounds (tiny residual gains) count as
+    much as round 1 — a feature split on only in later rounds must not
+    vanish from the importances."""
+    import spark_ensemble_tpu as se
+    from spark_ensemble_tpu.ops.tree import Tree, feature_gains
+
+    # two synthetic member trees over d=3: member 0 splits feature 0 with
+    # huge gain, member 1 splits feature 2 with tiny gain
+    def tree(feat, gain):
+        return Tree(
+            split_feature=jnp.asarray([feat], jnp.int32),
+            split_bin=jnp.asarray([0], jnp.int32),
+            split_threshold=jnp.asarray([0.0], jnp.float32),
+            leaf_value=jnp.zeros((2, 1), jnp.float32),
+            split_gain=jnp.asarray([gain], jnp.float32),
+        )
+
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), tree(0, 1e6), tree(2, 1e-4)
+    )
+    model = se.BaggingRegressor(num_base_learners=2).fit(
+        np.zeros((8, 3), np.float32), np.zeros((8,), np.float32)
+    )
+    model.params["members"] = stacked
+    fi = model.feature_importances_
+    np.testing.assert_allclose(fi, [0.5, 0.0, 0.5], atol=1e-12)
+    # raw gains helper keeps member axes
+    assert feature_gains(stacked, 3).shape == (2, 3)
