@@ -21,13 +21,23 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 
+# Bumped whenever the persisted member-pytree schema changes in a way a
+# resume cannot mix with (e.g. Tree.split_gain, round 3: resuming a
+# pre-gain checkpoint would backfill zero gains for the already-trained
+# members and silently skew feature_importances_ toward post-resume
+# rounds).  A mismatch makes the fit start fresh — full-model SAVES still
+# load across versions via per-class _persist_defaults hooks; only
+# mid-training state is version-pinned.
+_CHECKPOINT_FORMAT = 2
+
+
 def run_fingerprint(*parts) -> str:
     """Stable digest of estimator config + data shape, stored with each
     checkpoint so a stale checkpoint from a different run/config is never
     silently resumed."""
     import hashlib
 
-    blob = json.dumps(parts, sort_keys=True, default=str).encode()
+    blob = json.dumps((_CHECKPOINT_FORMAT,) + parts, sort_keys=True, default=str).encode()
     return hashlib.sha256(blob).hexdigest()[:16]
 
 
