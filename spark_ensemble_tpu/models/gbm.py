@@ -51,7 +51,7 @@ reference's entire distribution story, `GBMClassifier.scala:325-483`):
 from __future__ import annotations
 
 import logging
-from typing import Any, List
+from typing import Any, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -148,6 +148,22 @@ class _GBMParams(CheckpointableParams, Estimator):
     def _base(self) -> BaseLearner:
         return self.base_learner or DecisionTreeRegressor()
 
+    @property
+    def validation_history_(self) -> np.ndarray:
+        """Per-round validation losses from a fit with a validation split
+        (`GBMRegressor.scala:444-465` evaluates them; here they come back
+        from inside the chunked program and are stored on the model).
+        Includes every evaluated round — also the trailing patience rounds
+        the final model trims."""
+        params = getattr(self, "params", None)
+        vh = params.get("val_hist") if isinstance(params, dict) else None
+        if vh is None:
+            raise AttributeError(
+                "validation_history_ exists only on models fit with a "
+                "validation split (validation_indicator=...)"
+            )
+        return np.asarray(vh)
+
     def _sampling_plan(self, n: int, d: int):
         """Per-member (bag-weight key, feature mask); member seeds mirror the
         reference's ``seed + i`` discipline (`GBMRegressor.scala:282-284`).
@@ -214,6 +230,7 @@ class _GBMParams(CheckpointableParams, Estimator):
         i: int,
         v: int,
         best: float,
+        val_history: Optional[List[float]] = None,  # mutated: per-round val losses
     ):
         """The shared round-loop driver: scan-chunked dispatch (one program
         per `scan_chunk` rounds, single-chip AND under a mesh — validation
@@ -237,6 +254,8 @@ class _GBMParams(CheckpointableParams, Estimator):
             stopped = False
             if errs is not None:
                 for j, err in enumerate(np.asarray(errs)):
+                    if val_history is not None:
+                        val_history.append(float(err))
                     best, v = self._patience_step(
                         best, float(err), v, self.validation_tol
                     )
@@ -622,6 +641,7 @@ class GBMRegressor(_GBMParams):
 
         members_chunks: List[Any] = []
         weights_chunks: List[Any] = []
+        val_history: List[float] = []
         i, v = 0, 0
 
         # n_pad AND nv_pad are part of the identity: checkpointed `pred` /
@@ -633,6 +653,7 @@ class GBMRegressor(_GBMParams):
         if resumed is not None:
             last_round, st = resumed
             i, v, best = last_round + 1, int(st["v"]), float(st["best"])
+            val_history[:] = [float(x) for x in np.asarray(st.get("val_hist", []))]
             pred = jnp.asarray(st["pred"])
             if mesh is not None:
                 pred = jax.device_put(
@@ -659,6 +680,7 @@ class GBMRegressor(_GBMParams):
                 {
                     "v": v,
                     "best": best,
+                    "val_hist": jnp.asarray(val_history, jnp.float32),
                     "pred": pred,
                     "pred_val": pred_val,
                     "members_layout": self.MEMBERS_LAYOUT,
@@ -700,6 +722,7 @@ class GBMRegressor(_GBMParams):
         i, v, best = self._drive_rounds(
             ckpt, members_chunks, weights_chunks,
             run_chunk, save_state, "GBMRegressor", i, v, best,
+            val_history=val_history,
         )
         ckpt.delete()
 
@@ -715,6 +738,9 @@ class GBMRegressor(_GBMParams):
                 "weights": all_weights[:keep] if keep > 0 else jnp.zeros((0,)),
                 "masks": masks[:keep],
                 "init": init_model.params,
+                "val_hist": jnp.asarray(val_history, jnp.float32)
+                if with_validation
+                else None,
             },
             num_features=d,
             init_model=init_model,
@@ -755,6 +781,8 @@ class GBMRegressionModel(RegressionModel, GBMRegressor):
                 "weights": self.params["weights"][:k],
                 "masks": self.params["masks"][:k],
                 "init": self.params["init"],
+                # the prefix model's curve is exactly the first k entries
+                "val_hist": vh[:k] if (vh := self.params.get("val_hist")) is not None else None,
             },
             num_features=self.num_features,
             init_model=self.init_model,
@@ -1114,6 +1142,7 @@ class GBMClassifier(_GBMParams):
         # (leading axis = rounds), concatenated once at the end
         members_chunks: List[Any] = []
         weights_chunks: List[Any] = []
+        val_history: List[float] = []
         i, v = 0, 0
 
         # n_pad AND nv_pad in the identity: see GBMRegressor — padded
@@ -1123,6 +1152,7 @@ class GBMClassifier(_GBMParams):
         if resumed is not None:
             last_round, st = resumed
             i, v, best = last_round + 1, int(st["v"]), float(st["best"])
+            val_history[:] = [float(x) for x in np.asarray(st.get("val_hist", []))]
             pred = jnp.asarray(st["pred"])
             if mesh is not None:
                 pred = jax.device_put(
@@ -1148,6 +1178,7 @@ class GBMClassifier(_GBMParams):
                 {
                     "v": v,
                     "best": best,
+                    "val_hist": jnp.asarray(val_history, jnp.float32),
                     "pred": pred,
                     "pred_val": pred_val,
                     "members_layout": self.MEMBERS_LAYOUT,
@@ -1188,6 +1219,7 @@ class GBMClassifier(_GBMParams):
         i, v, best = self._drive_rounds(
             ckpt, members_chunks, weights_chunks,
             run_chunk, save_state, "GBMClassifier", i, v, best,
+            val_history=val_history,
         )
         ckpt.delete()
 
@@ -1205,6 +1237,9 @@ class GBMClassifier(_GBMParams):
                 else jnp.zeros((0, dim)),
                 "masks": masks[:keep],
                 "init_raw": init_raw,
+                "val_hist": jnp.asarray(val_history, jnp.float32)
+                if with_validation
+                else None,
             },
             num_features=d,
             num_classes=num_classes,
@@ -1269,6 +1304,7 @@ class GBMClassificationModel(ClassificationModel, GBMClassifier):
                 "weights": self.params["weights"][:k],
                 "masks": self.params["masks"][:k],
                 "init_raw": self.params["init_raw"],
+                "val_hist": vh[:k] if (vh := self.params.get("val_hist")) is not None else None,
             },
             num_features=self.num_features,
             num_classes=self.num_classes,

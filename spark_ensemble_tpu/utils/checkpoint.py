@@ -28,7 +28,7 @@ import numpy as np
 # rounds).  A mismatch makes the fit start fresh — full-model SAVES still
 # load across versions via per-class _persist_defaults hooks; only
 # mid-training state is version-pinned.
-_CHECKPOINT_FORMAT = 2
+_CHECKPOINT_FORMAT = 3  # 3: GBM state carries val_hist (round-aligned)
 
 
 def run_fingerprint(*parts) -> str:

@@ -273,3 +273,27 @@ def test_sampling_plan_bit_identical_to_eager_loop():
             np.asarray(jax.random.key_data(jax.random.fold_in(k, 2))),
             np.asarray(jax.random.key_data(bag_keys[i])),
         )
+
+
+def test_validation_history_recorded(cpusmall):
+    """Models fit with a validation split expose the per-round validation
+    loss curve; its argmin-side structure matches the early-stop result:
+    history covers exactly the evaluated rounds (kept + patience), and a
+    fit without validation raises."""
+    X, y = cpusmall
+    rng = np.random.RandomState(0)
+    is_val = rng.rand(len(X)) < 0.25
+    m = se.GBMRegressor(num_base_learners=12, num_rounds=2).fit(
+        X, y, validation_indicator=is_val
+    )
+    hist = m.validation_history_
+    assert hist.ndim == 1 and len(hist) >= m.num_members
+    # the early-stop accounting: evaluated rounds = kept + patience overrun
+    assert len(hist) <= 12
+    assert np.all(np.isfinite(hist))
+
+    m2 = se.GBMRegressor(num_base_learners=3).fit(X[:500], y[:500])
+    with pytest.raises(AttributeError):
+        m2.validation_history_
+    # prefix models carry the aligned prefix of the curve
+    np.testing.assert_allclose(m.take(2).validation_history_, hist[:2])
