@@ -226,6 +226,34 @@ class Model(Params):
             )
         return gains_fn(self.params, self.num_features)
 
+    def member(self, i: int) -> "Model":
+        """Member ``i`` as a standalone fitted model — the analogue of the
+        reference models' ``models`` array of base-learner models (e.g.
+        `BaggingClassificationModel`'s constructor arg).  Member params are
+        sliced out of the stacked pytree; subspace-trained members predict
+        correctly without their mask (splits/coefs never use masked
+        features).  GBMClassifier's [round, class-dim] grid overrides this
+        with a two-index version."""
+        if not (isinstance(self.params, dict) and "members" in self.params):
+            raise AttributeError(
+                f"{type(self).__name__} has no stacked members"
+            )
+        members = self.params["members"]
+        if members is None:
+            raise IndexError("model kept zero members")
+        # explicit bounds check: jax CLAMPS out-of-range integer indices,
+        # which would silently return the last member
+        n_members = jax.tree_util.tree_leaves(members)[0].shape[0]
+        if not 0 <= i < n_members:
+            raise IndexError(f"member index {i} out of range [0, {n_members})")
+        params_i = jax.tree_util.tree_map(lambda x: x[i], members)
+        base = self._base()
+        return base.model_from_params(
+            params_i,
+            self.num_features,
+            getattr(self, "num_classes", None) if base.is_classifier else None,
+        )
+
     def member_feature_names(self, i: int):
         """Feature names of member ``i``'s subspace — the reference
         re-indexes column metadata after ``slice()`` the same way."""

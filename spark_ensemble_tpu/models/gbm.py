@@ -1296,6 +1296,21 @@ class GBMClassificationModel(ClassificationModel, GBMClassifier):
         # rawPredictionCol is set — the evaluated behavior in every test
         return jnp.argmax(self.predict_raw(X), axis=-1).astype(jnp.float32)
 
+    def member(self, i: int, dim: int = 0):
+        """Round ``i``'s regressor for class dimension ``dim`` (the member
+        grid is [round, class-dim], `GBMClassifier.scala:377-411`)."""
+        members = self.params["members"]
+        if members is None:
+            raise IndexError("model kept zero members")
+        # explicit bounds checks: jax clamps out-of-range integer indices
+        rounds, dims = jax.tree_util.tree_leaves(members)[0].shape[:2]
+        if not 0 <= i < rounds:
+            raise IndexError(f"round index {i} out of range [0, {rounds})")
+        if not 0 <= dim < dims:
+            raise IndexError(f"class-dim index {dim} out of range [0, {dims})")
+        params_i = jax.tree_util.tree_map(lambda x: x[i, dim], members)
+        return self._base().model_from_params(params_i, self.num_features)
+
     def take(self, k: int) -> "GBMClassificationModel":
         k = min(k, self.num_members)
         return GBMClassificationModel(

@@ -107,3 +107,34 @@ def test_member_plan_bit_identical_to_eager_loop():
             np.asarray(jax.random.key_data(key)),
             np.asarray(jax.random.key_data(keys[i])),
         )
+
+
+def test_member_extraction_matches_member_predictions(letter):
+    """model.member(i) is member i as a standalone fitted model (the
+    reference models' `models` array); its predictions match the fused
+    member_predictions row."""
+    X, y = letter
+    Xs, ys = X[:2000], y[:2000]
+    bag = se.BaggingClassifier(
+        num_base_learners=3, subspace_ratio=0.7, seed=1
+    ).fit(Xs, ys)
+    fused = np.asarray(bag.member_class_predictions(Xs[:300]))
+    for i in range(3):
+        m = bag.member(i)
+        np.testing.assert_array_equal(
+            np.asarray(m.predict(Xs[:300])), fused[i]
+        )
+    # GBM regressor members (rounds) and classifier grid members
+    yk = (Xs[:, 0] > Xs[:, 0].mean()).astype(np.float32)
+    g = se.GBMClassifier(num_base_learners=2).fit(Xs, yk)
+    sub = g.member(1, dim=0)
+    assert np.isfinite(np.asarray(sub.predict(Xs[:50]))).all()
+    import pytest
+
+    with pytest.raises(AttributeError):
+        se.DecisionTreeClassifier().fit(Xs, ys).member(0)
+    # jax clamps out-of-range indices; member() must bounds-check instead
+    with pytest.raises(IndexError):
+        bag.member(3)
+    with pytest.raises(IndexError):
+        g.member(0, dim=99)
