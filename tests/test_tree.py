@@ -679,3 +679,59 @@ def test_feature_importances_normalize_per_member():
     np.testing.assert_allclose(fi, [0.5, 0.0, 0.5], atol=1e-12)
     # raw gains helper keeps member axes
     assert feature_gains(stacked, 3).shape == (2, 3)
+
+
+def test_histogram_subtraction_tier_matches_exact_splits():
+    """Fast tiers derive right-child histograms as parent - left (one
+    matmul per level over HALF the nodes); a child/parent interleave bug
+    would scramble deep splits, so pin near-exact agreement with the
+    full-computation exact tier on a well-separated problem."""
+    rng = np.random.RandomState(7)
+    n, d = 3000, 6
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] * X[:, 1] + np.sin(2 * X[:, 2])).astype(np.float32)
+    b = compute_bins(jnp.asarray(X), 32)
+    Xb = bin_features(jnp.asarray(X), b)
+    w = jnp.ones((n,))
+    kw = dict(max_depth=5, max_bins=32, hist="matmul")
+    t_ex = fit_tree(
+        Xb, jnp.asarray(y)[:, None], w, b.thresholds,
+        hist_precision="highest", **kw
+    )
+    t_hi = fit_tree(
+        Xb, jnp.asarray(y)[:, None], w, b.thresholds,
+        hist_precision="high", **kw
+    )
+    agree = float(
+        np.mean(np.asarray(t_ex.split_feature) == np.asarray(t_hi.split_feature))
+    )
+    assert agree > 0.9, agree
+    r_ex = rmse(predict_tree_binned(t_ex, Xb)[:, 0], y)
+    r_hi = rmse(predict_tree_binned(t_hi, Xb)[:, 0], y)
+    assert abs(r_ex - r_hi) < 0.03 * max(r_ex, r_hi) + 1e-6
+
+
+def test_subtraction_path_empty_children_record_no_spurious_splits():
+    """An empty child's derived histogram (parent - left) carries tier
+    rounding noise instead of exact zeros; the tier-scaled validity floor
+    must keep such nodes split-free (else garbage split_gain pollutes
+    feature importances).  Construction: one binary informative feature,
+    all others constant — below level 1 every node is pure, its children
+    route fully left, so right children at level >= 2 are empty."""
+    n = 512
+    X = np.zeros((n, 3), np.float32)
+    X[: n // 2, 0] = 1.0
+    y = X[:, 0].copy()
+    b = compute_bins(jnp.asarray(X), 16)
+    Xb = bin_features(jnp.asarray(X), b)
+    w = jnp.ones((n,))
+    for tier in ("default", "high"):
+        t = fit_tree(
+            Xb, jnp.asarray(y)[:, None], w, b.thresholds,
+            max_depth=4, max_bins=16, hist="matmul", hist_precision=tier,
+        )
+        gains = np.asarray(t.split_gain)
+        assert gains[0] > 0  # the real root split
+        np.testing.assert_allclose(gains[1:], 0.0, atol=1e-6)
+        feats = np.asarray(t.split_feature)
+        assert (feats[1:] == 0).all()  # sentinel feature 0, no real splits
