@@ -717,7 +717,15 @@ def test_subtraction_path_empty_children_record_no_spurious_splits():
     must keep such nodes split-free (else garbage split_gain pollutes
     feature importances).  Construction: one binary informative feature,
     all others constant — below level 1 every node is pure, its children
-    route fully left, so right children at level >= 2 are empty."""
+    route fully left, so right children at level >= 2 are empty.
+
+    CPU caveat: matmul Precision tiers are all f32 on CPU, so the bf16
+    noise itself cannot materialize here — this pins the exact-zero
+    behavior and that the floor logic traces/runs; the tier-scaled,
+    carried-forward floor (`_derived_hist_weight_floor`) is sized
+    analytically for the on-chip bf16 noise bound (~2^-8 relative,
+    floor 1e-2 of the tree-parent weight, never decaying down a chain
+    of empty nodes)."""
     n = 512
     X = np.zeros((n, 3), np.float32)
     X[: n // 2, 0] = 1.0
