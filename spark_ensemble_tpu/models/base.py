@@ -290,7 +290,15 @@ class Model(Params):
 
 
 class RegressionModel(Model):
-    pass
+    def score(self, X, y, sample_weight=None) -> float:
+        """R^2 on (X, y) — the default metric of the RegressionEvaluator's
+        Spark counterpart family; equivalent to
+        ``RegressionEvaluator(metric="r2").evaluate(self, X, y)``."""
+        from spark_ensemble_tpu.evaluation import RegressionEvaluator
+
+        return RegressionEvaluator(metric="r2").evaluate(
+            self, X, y, sample_weight
+        )
 
 
 class ClassificationModel(Model):
@@ -308,6 +316,17 @@ class ClassificationModel(Model):
 
     def predict(self, X) -> jax.Array:
         return jnp.argmax(self.predict_proba(X), axis=-1).astype(jnp.float32)
+
+    def score(self, X, y, sample_weight=None) -> float:
+        """Accuracy on (X, y); equivalent to
+        ``MulticlassClassificationEvaluator(metric="accuracy")``."""
+        from spark_ensemble_tpu.evaluation import (
+            MulticlassClassificationEvaluator,
+        )
+
+        return MulticlassClassificationEvaluator(metric="accuracy").evaluate(
+            self, X, y, sample_weight
+        )
 
 
 class CheckpointableParams(Params):

@@ -156,3 +156,27 @@ def test_binary_auc_tied_scores_give_chance_level():
     assert ev.evaluate(_FixedModel(proba=proba2), np.zeros((4, 1)), y2) == pytest.approx(
         1.0, abs=1e-6
     )
+
+
+def test_model_score_convenience():
+    """model.score(X, y) == the corresponding evaluator's default metric
+    (accuracy for classifiers, R^2 for regressors)."""
+    import spark_ensemble_tpu as se
+    from spark_ensemble_tpu.evaluation import (
+        MulticlassClassificationEvaluator,
+        RegressionEvaluator,
+    )
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 4).astype(np.float32)
+    yk = (X[:, 0] > 0).astype(np.float32)
+    yr = (2 * X[:, 1] + 0.1 * rng.randn(500)).astype(np.float32)
+    c = se.DecisionTreeClassifier(max_depth=3).fit(X, yk)
+    assert c.score(X, yk) == MulticlassClassificationEvaluator(
+        metric="accuracy"
+    ).evaluate(c, X, yk)
+    r = se.GBMRegressor(num_base_learners=3).fit(X, yr)
+    assert r.score(X, yr) == RegressionEvaluator(metric="r2").evaluate(
+        r, X, yr
+    )
+    assert r.score(X, yr) > 0.5
