@@ -1,0 +1,133 @@
+"""Property-based tests (hypothesis) — the direct analogue of the
+reference's ScalaCheck suites (`UtilsSuite.scala:29-67`,
+`HasSubBagSuite.scala:60-105`, `GBMLossSuite.scala:84-125`).
+
+Two environment constraints shape these tests:
+- shapes are FIXED per property so the jitted kernels compile once and
+  every generated example reuses the executable;
+- values are generated as INTEGERS and scaled in-test: jaxlib enables
+  fast-math/FTZ on the process, which trips hypothesis's float-environment
+  self-check (signed-zero/subnormal detection) inside `st.floats`.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from spark_ensemble_tpu.ops import losses as losses_mod
+from spark_ensemble_tpu.utils.quantile import weighted_median
+from spark_ensemble_tpu.utils.random import bootstrap_weights, subspace_mask
+
+_N = 64
+
+_int_vals = st.lists(
+    st.integers(-(10**6), 10**6), min_size=_N, max_size=_N
+)
+_int_weights = st.lists(st.integers(1, 10**5), min_size=_N, max_size=_N)
+
+
+def _vals(ints):
+    return jnp.asarray(np.asarray(ints, np.float32) / 1e3)
+
+
+def _wts(ints):
+    return jnp.asarray(np.asarray(ints, np.float32) / 1e2)
+
+
+@settings(max_examples=25, deadline=None)
+@given(_int_vals, _int_weights, st.integers(1, 1000))
+def test_weighted_median_scale_invariant(v, w, c):
+    """`UtilsSuite.scala`: scaling all weights never moves the median."""
+    v, w = _vals(v), _wts(w)
+    scale = jnp.float32(c / 10.0)
+    assert float(weighted_median(v, w)) == float(weighted_median(v, scale * w))
+
+
+@settings(max_examples=25, deadline=None)
+@given(_int_vals, _int_weights)
+def test_weighted_median_is_an_element_and_order_invariant(v, w):
+    """The weighted median is one of the values, and permuting the rows
+    (same (v, w) pairs) never changes it."""
+    v, w = _vals(v), _wts(w)
+    med = float(weighted_median(v, w))
+    assert med in np.asarray(v)
+    perm = np.random.RandomState(0).permutation(_N)
+    assert float(weighted_median(v[perm], w[perm])) == med
+
+
+@settings(max_examples=25, deadline=None)
+@given(_int_vals)
+def test_weighted_median_unit_weights_matches_ge_half_rule(v):
+    """With unit weights the >= 1/2 cumulative rule picks the
+    ceil(n/2)-th order statistic (the reference's exact semantics)."""
+    v = _vals(v)
+    med = float(weighted_median(v, jnp.ones((_N,))))
+    s = np.sort(np.asarray(v))
+    assert med == s[(_N + 1) // 2 - 1]
+
+
+@settings(max_examples=25, deadline=None)
+@given(st.integers(0, 2**31 - 1), st.integers(5, 100))
+def test_subspace_mask_properties(seed, ratio_pct):
+    """`HasSubBagSuite.scala`: at least one active feature for any ratio,
+    determinism in the key, and ratio=1 selects everything."""
+    ratio = ratio_pct / 100.0
+    key = jax.random.PRNGKey(seed)
+    m = np.asarray(subspace_mask(key, 16, ratio))
+    assert m.dtype == bool and m.shape == (16,)
+    assert m.sum() >= 1
+    m2 = np.asarray(subspace_mask(key, 16, ratio))
+    np.testing.assert_array_equal(m, m2)
+    if ratio == 1.0:
+        assert m.all()
+
+
+@settings(max_examples=25, deadline=None)
+@given(st.integers(0, 2**31 - 1), st.integers(10, 100))
+def test_bootstrap_weights_semantics(seed, ratio_pct):
+    """`RDD.sample` semantics: replacement=True draws Poisson counts
+    (non-negative integers), replacement=False Bernoulli 0/1; both keep
+    the static shape."""
+    ratio = ratio_pct / 100.0
+    key = jax.random.PRNGKey(seed)
+    pois = np.asarray(bootstrap_weights(key, _N, True, ratio))
+    bern = np.asarray(bootstrap_weights(key, _N, False, ratio))
+    assert pois.shape == bern.shape == (_N,)
+    assert (pois >= 0).all() and (pois == np.round(pois)).all()
+    assert set(np.unique(bern)) <= {0.0, 1.0}
+
+
+_HUBER_DELTA = 1.3
+_LOSSES = [
+    losses_mod.SquaredLoss(),
+    losses_mod.LogCoshLoss(),
+    losses_mod.HuberLoss(_HUBER_DELTA),
+    losses_mod.QuantileLoss(0.3),
+]
+
+
+@settings(max_examples=15, deadline=None)
+@given(st.integers(-500, 500), st.integers(-500, 500))
+def test_loss_gradients_match_numerical(yi, fi):
+    """`GBMLossSuite.scala:84-125` gradient checking: every regression
+    loss's analytic gradient matches a central difference at generated
+    (label, prediction) points, away from non-smooth kinks."""
+    y, f = yi / 100.0, fi / 100.0
+    eps = 1e-3
+    r = abs(y - f)
+    for loss in _LOSSES:
+        if isinstance(loss, losses_mod.QuantileLoss) and r < 5 * eps:
+            continue  # kink at residual 0: one-sided derivative
+        if isinstance(loss, losses_mod.HuberLoss) and abs(r - _HUBER_DELTA) < 5 * eps:
+            continue  # kink at |residual| == delta
+        # losses operate on ENCODED [n, dim] labels/predictions (dim=1
+        # for regression; loss() sums its last axis)
+        ya = jnp.asarray([[y]], jnp.float32)
+        grad = float(loss.gradient(ya, jnp.asarray([[f]], jnp.float32))[0, 0])
+        lp = float(loss.loss(ya, jnp.asarray([[f + eps]], jnp.float32))[0])
+        lm = float(loss.loss(ya, jnp.asarray([[f - eps]], jnp.float32))[0])
+        num = (lp - lm) / (2 * eps)
+        assert abs(grad - num) < 5e-2 + 1e-2 * abs(num), (
+            type(loss).__name__, y, f, grad, num,
+        )
