@@ -44,6 +44,10 @@ from spark_ensemble_tpu.models.linear import (
     LogisticRegression,
     LogisticRegressionModel,
 )
+from spark_ensemble_tpu.models.linear_tree import (
+    LinearTreeRegressionModel,
+    LinearTreeRegressor,
+)
 from spark_ensemble_tpu.models.mlp import (
     MLPClassificationModel,
     MLPClassifier,
@@ -121,6 +125,8 @@ __all__ = [
     "LogisticRegressionModel",
     "GaussianNaiveBayes",
     "GaussianNaiveBayesModel",
+    "LinearTreeRegressor",
+    "LinearTreeRegressionModel",
     "MLPClassifier",
     "MLPClassificationModel",
     "MLPRegressor",

@@ -1,0 +1,184 @@
+"""Linear-leaf regression trees: piece-wise linear base learner.
+
+"Gradient Boosting With Piece-Wise Linear Regression Trees" (Shi et al.,
+arXiv:1802.05640, PAPERS.md): constant leaves force many boosting rounds
+to express smooth trends; fitting a small ridge regression IN each leaf
+captures them directly, so GBM needs far fewer rounds for the same loss.
+The reference has no such learner — it is an extension the TPU mapping
+makes nearly free, because every step is an MXU contraction:
+
+1. fit the histogram tree exactly as ``DecisionTreeRegressor`` does
+   (`ops/tree.py fit_tree` — same splits, same distributed psum story);
+2. route rows to leaves with the exact one-hot matmul
+   (`ops.tree.leaf_one_hot`);
+3. accumulate EVERY leaf's weighted normal equations in two einsum
+   contractions (``[leaves, d+1, d+1]`` and ``[leaves, d+1]``; psum-ed
+   over the mesh data axis under SPMD), and solve them as one batched
+   Cholesky — there is no per-leaf loop anywhere;
+4. leaves with too little weight to determine a d+1-parameter model fall
+   back to the tree's constant leaf value.
+
+Prediction: leaf one-hot selects the row's coefficient vector (one-term
+exact matmul), then a dot with the standardized features.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from spark_ensemble_tpu.models.base import (
+    RegressionModel,
+    as_f32,
+)
+from spark_ensemble_tpu.models.linear import _apply_mask, _feature_stats
+from spark_ensemble_tpu.models.tree import DecisionTreeRegressor
+from spark_ensemble_tpu.ops.collective import preduce
+from spark_ensemble_tpu.ops.tree import Tree, feature_gains, leaf_one_hot
+from spark_ensemble_tpu.params import Param, gt_eq, in_range
+
+
+class LinearTreeRegressor(DecisionTreeRegressor):
+    """Histogram tree with ridge-regression leaves (regressor only — GBM
+    members are regressors, `GBMParams.scala:29-44`)."""
+
+    reg_param = Param(1e-3, gt_eq(0.0), doc="leaf ridge strength")
+    min_leaf_weight = Param(
+        8.0,
+        gt_eq(0.0),
+        doc="minimum EFFECTIVE row support for a linear leaf: leaves whose "
+        "weight is below min_leaf_weight times the mean positive row "
+        "weight keep the constant tree value (a d+1-parameter model needs "
+        "that much support).  Relative to the mean weight so normalized "
+        "weight vectors (boosting's w/sum(w)) behave like unit weights",
+    )
+    # the leaf one-hot materializes [n, 2^depth] and the path matrix grows
+    # 4^depth (ops.tree leaf_one_hot); cap at the matmul-predict depth
+    max_depth = Param(5, in_range(1, 10))
+
+    def make_fit_ctx(self, X, num_classes=None):
+        ctx = super().make_fit_ctx(X, num_classes)
+        ctx["X"] = as_f32(X)  # raw features for the leaf models
+        return ctx
+
+    def ctx_specs(self, ctx, data_axis):
+        from jax.sharding import PartitionSpec as P
+
+        specs = super().ctx_specs(ctx, data_axis)
+        specs["X"] = P(data_axis, None)
+        return specs
+
+    def _leaf_models(self, ctx, tree: Tree, y, w, feature_mask, axis_name):
+        """The leaf-regression stage on a fitted constant-leaf tree."""
+        X = _apply_mask(ctx["X"], feature_mask)
+        n, d = X.shape
+        mu, sd = _feature_stats(X, w, axis_name)
+        Xs = jnp.concatenate(
+            [(X - mu[None, :]) / sd[None, :], jnp.ones((n, 1), X.dtype)],
+            axis=1,
+        )  # [n, d+1]
+        oh = leaf_one_hot(tree, ctx["Xb"], binned=True)  # [n, leaves] exact
+        Xw = Xs * w[:, None]
+        # every leaf's normal equations in two contractions (psum-ed):
+        A = preduce(jnp.einsum("nl,nd,ne->lde", oh, Xw, Xs), axis_name)
+        b = preduce(jnp.einsum("nl,nd,n->ld", oh, Xw, y), axis_name)
+        leaf_w = preduce(jnp.einsum("nl,n->l", oh, w), axis_name)
+        ridge = (self.reg_param + 1e-6) * jnp.eye(d + 1, dtype=X.dtype)
+        beta = jax.vmap(
+            lambda Ai, bi: jax.scipy.linalg.solve(
+                Ai + ridge, bi, assume_a="pos"
+            )
+        )(A, b)  # [leaves, d+1]
+        # underdetermined leaves keep the constant tree value; the support
+        # bar is in EFFECTIVE rows (weight / mean positive weight), so a
+        # normalized weight vector (boosting's w/sum(w)) behaves exactly
+        # like unit weights
+        present = (w > 0).astype(jnp.float32)
+        n_present = jnp.maximum(preduce(jnp.sum(present), axis_name), 1.0)
+        w_bar = preduce(jnp.sum(w), axis_name) / n_present
+        const = jnp.concatenate(
+            [
+                jnp.zeros((tree.leaf_value.shape[0], d), X.dtype),
+                tree.leaf_value[:, :1],
+            ],
+            axis=1,
+        )
+        ok = (leaf_w >= self.min_leaf_weight * w_bar)[:, None]
+        beta = jnp.where(ok & jnp.isfinite(beta).all(1, keepdims=True), beta, const)
+        mask = (
+            feature_mask.astype(jnp.float32)
+            if feature_mask is not None
+            else jnp.ones((d,), jnp.float32)
+        )
+        return {
+            "tree": tree,
+            "beta": beta,
+            "x_mu": mu,
+            "x_sd": sd,
+            "mask": mask,
+        }
+
+    def fit_from_ctx(self, ctx, y, w, feature_mask, key, axis_name=None):
+        tree: Tree = super().fit_from_ctx(
+            ctx, y, w, feature_mask, key, axis_name=axis_name
+        )
+        return self._leaf_models(ctx, tree, y, w, feature_mask, axis_name)
+
+    def fit_many_from_ctx(self, ctx, ys, ws, feature_masks, keys, axis_name=None):
+        """Member fits keep the FUSED forest histogram build (one matmul per
+        level for every member, `_TreeLearner.fit_many_from_ctx`); only the
+        cheap leaf-regression stage — two einsums and a batched Cholesky
+        per member — runs vmapped on top."""
+        trees = super().fit_many_from_ctx(
+            ctx, ys, ws, feature_masks, keys, axis_name=axis_name
+        )
+        M = ys.shape[1]
+        if feature_masks is None:
+            return jax.vmap(
+                lambda tree, y, w: self._leaf_models(
+                    ctx, tree, y, w, None, axis_name
+                ),
+                in_axes=(0, 1, 1),
+            )(trees, ys, ws)
+        if feature_masks.ndim == 1:
+            feature_masks = jnp.broadcast_to(
+                feature_masks[None, :], (M,) + feature_masks.shape
+            )
+        return jax.vmap(
+            lambda tree, y, w, m: self._leaf_models(
+                ctx, tree, y, w, m, axis_name
+            ),
+            in_axes=(0, 1, 1, 0),
+        )(trees, ys, ws, feature_masks)
+
+    def predict_fn(self, params, X):
+        X = as_f32(X)
+        Xm = _apply_mask(X, params["mask"])
+        oh = leaf_one_hot(params["tree"], Xm, binned=False)
+        # one-term exact selection of each row's coefficients
+        beta_row = jax.lax.dot_general(
+            oh,
+            params["beta"],
+            (((1,), (0,)), ((), ())),
+            precision=(jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST),
+        )  # [n, d+1]
+        Xs = (Xm - params["x_mu"][None, :]) / params["x_sd"][None, :]
+        return jnp.sum(Xs * beta_row[:, :-1], axis=1) + beta_row[:, -1]
+
+    def predict_many_fn(self, params, X):
+        return jax.vmap(lambda p: self.predict_fn(p, X))(params)
+
+    def feature_gains_fn(self, params, d: int):
+        # importances come from the tree's split gains (the leaf models
+        # refine within leaves; they do not re-rank features)
+        return feature_gains(params["tree"], d)
+
+    def model_from_params(self, params, num_features, num_classes=None):
+        return LinearTreeRegressionModel(
+            params=params, num_features=num_features, **self.get_params()
+        )
+
+
+class LinearTreeRegressionModel(RegressionModel, LinearTreeRegressor):
+    def predict(self, X):
+        return self.predict_fn(self.params, as_f32(X))

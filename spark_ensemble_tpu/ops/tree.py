@@ -778,11 +778,10 @@ def _select_columns(X: jax.Array, f: jax.Array, d: int) -> jax.Array:
     )
 
 
-def _predict_dense(bits: jax.Array, leaf_value: jax.Array, depth: int) -> jax.Array:
-    """Leaf values from per-node go-left bits via two MXU matmuls: score all
-    leaf paths at once, then select with the exact one-hot of the (unique)
-    satisfied path.  Replaces the level-serial gather walk the round-1
-    VERDICT flagged as the predict bottleneck."""
+def _leaf_one_hot_from_bits(bits: jax.Array, depth: int) -> jax.Array:
+    """Exact ``f32[n, 2^depth]`` leaf one-hot from per-node go-left bits via
+    one MXU matmul: score every leaf path at once, then threshold — each
+    row satisfies exactly one complete path."""
     C, c0 = _path_constants(depth)
     # bits (0/1) and C (-1/0/+1) are exactly bf16-representable and the MXU
     # accumulates in f32, so single-pass DEFAULT is bit-exact here — 6x
@@ -796,7 +795,36 @@ def _predict_dense(bits: jax.Array, leaf_value: jax.Array, depth: int) -> jax.Ar
         )
         + jnp.asarray(c0)[None, :]
     )
-    leaf_oh = (score >= depth - 0.5).astype(jnp.float32)  # exactly one-hot
+    return (score >= depth - 0.5).astype(jnp.float32)
+
+
+def leaf_one_hot(tree: Tree, X: jax.Array, binned: bool) -> jax.Array:
+    """Exact leaf-membership one-hot ``f32[n, 2^depth]`` for raw
+    (``binned=False``) or pre-binned (``binned=True``) features — the
+    row→leaf routing building block the linear-leaf learner batches its
+    per-leaf regressions with."""
+    leaf_first = tree.split_feature.shape[0]
+    depth = (leaf_first + 1).bit_length() - 1
+    if depth > _MATMUL_PREDICT_MAX_DEPTH:
+        # the path-constant matrix grows 4^depth (TB-scale at the legal
+        # max_depth=20); a materialized [n, 2^depth] one-hot is equally
+        # unusable, so callers must cap depth instead
+        raise ValueError(
+            f"leaf_one_hot supports depth <= {_MATMUL_PREDICT_MAX_DEPTH}; "
+            f"got {depth}"
+        )
+    Xg = _select_columns(X, tree.split_feature, X.shape[1])
+    keys = tree.split_bin.astype(jnp.float32) if binned else tree.split_threshold
+    bits = (Xg <= keys[None, :]).astype(jnp.float32)
+    return _leaf_one_hot_from_bits(bits, depth)
+
+
+def _predict_dense(bits: jax.Array, leaf_value: jax.Array, depth: int) -> jax.Array:
+    """Leaf values from per-node go-left bits via two MXU matmuls: score all
+    leaf paths at once, then select with the exact one-hot of the (unique)
+    satisfied path.  Replaces the level-serial gather walk the round-1
+    VERDICT flagged as the predict bottleneck."""
+    leaf_oh = _leaf_one_hot_from_bits(bits, depth)  # exactly one-hot
     # exact one-hot side takes a single decomposition term (same bit-exact
     # halving as _stat_precision_vs_onehot); the value side stays HIGHEST
     return jax.lax.dot_general(

@@ -31,6 +31,7 @@ def _class_registry():
         dummy,
         gbm,
         linear,
+        linear_tree,
         mlp,
         naive_bayes,
         stacking,
@@ -44,6 +45,7 @@ def _class_registry():
         dummy,
         gbm,
         linear,
+        linear_tree,
         mlp,
         naive_bayes,
         stacking,

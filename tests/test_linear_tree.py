@@ -1,0 +1,125 @@
+"""Linear-leaf tree tests (piece-wise linear regression trees,
+arXiv:1802.05640 — an extension beyond the reference's learner set; see
+models/linear_tree.py)."""
+
+import numpy as np
+import pytest
+
+import spark_ensemble_tpu as se
+from spark_ensemble_tpu.parallel.mesh import data_member_mesh
+from spark_ensemble_tpu.utils import persist
+
+
+def _piecewise_linear(n=3000, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 4).astype(np.float32)
+    y = (
+        np.where(X[:, 0] > 0, 2.0 * X[:, 1] + 1.0, -1.5 * X[:, 1] + 3.0 * X[:, 2])
+        + 0.05 * rng.randn(n)
+    ).astype(np.float32)
+    return X, y
+
+
+def _rmse(m, X, y):
+    return float(np.sqrt(np.mean((np.asarray(m.predict(X)) - y) ** 2)))
+
+
+def test_linear_leaves_beat_constant_leaves_at_equal_depth():
+    X, y = _piecewise_linear()
+    lt = se.LinearTreeRegressor(max_depth=2).fit(X, y)
+    dt = se.DecisionTreeRegressor(max_depth=2).fit(X, y)
+    assert _rmse(lt, X, y) < 0.7 * _rmse(dt, X, y)
+
+
+def test_gbm_with_linear_leaf_members_needs_fewer_rounds():
+    """The paper's claim: linear leaves capture smooth trends that cost
+    constant-leaf GBM many rounds."""
+    X, y = _piecewise_linear()
+    g_lt = se.GBMRegressor(
+        base_learner=se.LinearTreeRegressor(max_depth=2),
+        num_base_learners=4, learning_rate=0.5,
+    ).fit(X, y)
+    g_dt = se.GBMRegressor(
+        base_learner=se.DecisionTreeRegressor(max_depth=2),
+        num_base_learners=4, learning_rate=0.5,
+    ).fit(X, y)
+    assert _rmse(g_lt, X, y) < _rmse(g_dt, X, y)
+
+
+def test_high_min_leaf_weight_falls_back_to_constant_tree():
+    """Leaves without enough support keep the constant tree value — with
+    an unreachable support bar the model must equal the plain tree."""
+    X, y = _piecewise_linear(800)
+    lt = se.LinearTreeRegressor(max_depth=3, min_leaf_weight=1e9).fit(X, y)
+    dt = se.DecisionTreeRegressor(max_depth=3).fit(X, y)
+    np.testing.assert_allclose(
+        np.asarray(lt.predict(X)), np.asarray(dt.predict(X)), atol=1e-4
+    )
+
+
+def test_linear_tree_persist_and_importances(tmp_path):
+    X, y = _piecewise_linear(1000)
+    m = se.LinearTreeRegressor(max_depth=2).fit(X, y)
+    m.save(str(tmp_path / "m"))
+    m2 = persist.load(str(tmp_path / "m"))
+    np.testing.assert_allclose(
+        np.asarray(m2.predict(X)), np.asarray(m.predict(X))
+    )
+    fi = m.feature_importances_
+    assert abs(fi.sum() - 1.0) < 1e-9
+
+
+@pytest.mark.slow
+def test_linear_tree_mesh_fit_matches_single_device():
+    """SPMD: tree histograms AND the leaf normal equations psum over the
+    data axis; the distributed fit matches single-device."""
+    X, y = _piecewise_linear(1003)  # non-multiple of the data axis
+    est = se.LinearTreeRegressor(max_depth=2)
+    p1 = np.asarray(est.fit(X, y).predict(X))
+    p2 = np.asarray(
+        est.fit(X, y, mesh=data_member_mesh(8, member=2)).predict(X)
+    )
+    np.testing.assert_allclose(p1, p2, atol=5e-3)
+
+
+def test_linear_tree_as_bagging_member():
+    X, y = _piecewise_linear(1500)
+    bag = se.BaggingRegressor(
+        base_learner=se.LinearTreeRegressor(max_depth=2), num_base_learners=4
+    ).fit(X, y)
+    const = float(np.sqrt(np.var(y)))
+    assert _rmse(bag, X, y) < 0.6 * const
+
+
+def test_normalized_weights_keep_linear_leaves():
+    """Boosting normalizes weights to sum 1 before member fits; the
+    effective-row support bar must not silently degrade every leaf to a
+    constant (absolute thresholds did)."""
+    X, y = _piecewise_linear(1200)
+    w = np.full(len(X), 1.0 / len(X), np.float32)  # sums to 1
+    m = se.LinearTreeRegressor(max_depth=2).fit(X, y, sample_weight=w)
+    m_unit = se.LinearTreeRegressor(max_depth=2).fit(X, y)
+    # metric-level equivalence: rescaling all weights by 1/n flips f32
+    # near-tied split argmaxes (the documented tie behavior), so compare
+    # fit quality, not pointwise predictions
+    assert abs(_rmse(m, X, y) - _rmse(m_unit, X, y)) < 0.05 * _rmse(
+        m_unit, X, y
+    ) + 1e-6
+    dt = se.DecisionTreeRegressor(max_depth=2).fit(X, y, sample_weight=w)
+    assert _rmse(m, X, y) < 0.7 * _rmse(dt, X, y)
+
+
+def test_boosting_with_linear_tree_members():
+    X, y = _piecewise_linear(1500)
+    b = se.BoostingRegressor(
+        base_learner=se.LinearTreeRegressor(max_depth=2), num_base_learners=4
+    ).fit(X, y)
+    const = float(np.sqrt(np.var(y)))
+    assert _rmse(b, X, y) < 0.5 * const
+
+
+def test_linear_tree_depth_capped():
+    import pytest as _p
+
+    with _p.raises(ValueError):
+        se.LinearTreeRegressor(max_depth=12)
