@@ -274,6 +274,13 @@ def _bench_full_extras():
         "gbmreg_cpusmall_fit_s": lambda: se.GBMRegressor(
             num_base_learners=100
         ).fit(*cpusmall),
+        # linear-leaf members reach comparable loss in 10 rounds
+        # (models/linear_tree.py; extension beyond the reference)
+        "gbmreg_cpusmall_lineartree10_fit_s": lambda: se.GBMRegressor(
+            base_learner=se.LinearTreeRegressor(max_depth=5),
+            num_base_learners=10,
+            learning_rate=0.3,
+        ).fit(*cpusmall),
         # StackingClassifier (DT + LR + NB, LR meta) on adult
         "stacking_adult_fit_s": lambda: se.StackingClassifier(
             base_learners=[

@@ -34,7 +34,13 @@ from spark_ensemble_tpu.models.base import (
 from spark_ensemble_tpu.models.linear import _apply_mask, _feature_stats
 from spark_ensemble_tpu.models.tree import DecisionTreeRegressor
 from spark_ensemble_tpu.ops.collective import preduce
-from spark_ensemble_tpu.ops.tree import Tree, feature_gains, leaf_one_hot
+from spark_ensemble_tpu.ops.tree import (
+    _F32_MAX,
+    Tree,
+    feature_gains,
+    leaf_one_hot,
+    leaf_one_hot_forest,
+)
 from spark_ensemble_tpu.params import Param, gt_eq, in_range
 
 
@@ -83,7 +89,18 @@ class LinearTreeRegressor(DecisionTreeRegressor):
         A = preduce(jnp.einsum("nl,nd,ne->lde", oh, Xw, Xs), axis_name)
         b = preduce(jnp.einsum("nl,nd,n->ld", oh, Xw, y), axis_name)
         leaf_w = preduce(jnp.einsum("nl,n->l", oh, w), axis_name)
-        ridge = (self.reg_param + 1e-6) * jnp.eye(d + 1, dtype=X.dtype)
+        # penalize SLOPES only: an unpenalized intercept means a feature
+        # that is constant WITHIN a leaf (collinear with the bias column)
+        # gets slope exactly 0 instead of an arbitrary bias/slope split
+        # that explodes under extrapolation
+        ridge = jnp.diag(
+            jnp.concatenate(
+                [
+                    jnp.full((d,), self.reg_param + 1e-6, X.dtype),
+                    jnp.asarray([1e-8], X.dtype),
+                ]
+            )
+        )
         beta = jax.vmap(
             lambda Ai, bi: jax.scipy.linalg.solve(
                 Ai + ridge, bi, assume_a="pos"
@@ -103,7 +120,10 @@ class LinearTreeRegressor(DecisionTreeRegressor):
             ],
             axis=1,
         )
-        ok = (leaf_w >= self.min_leaf_weight * w_bar)[:, None]
+        # STRICT inequality: with min_leaf_weight=0 a training-empty leaf
+        # (leaf_w == 0) must still fall back to the tree's parent-fallback
+        # value, not to an all-zero solve
+        ok = (leaf_w > self.min_leaf_weight * w_bar)[:, None]
         beta = jnp.where(ok & jnp.isfinite(beta).all(1, keepdims=True), beta, const)
         mask = (
             feature_mask.astype(jnp.float32)
@@ -153,7 +173,14 @@ class LinearTreeRegressor(DecisionTreeRegressor):
 
     def predict_fn(self, params, X):
         X = as_f32(X)
-        Xm = _apply_mask(X, params["mask"])
+        # rows with any non-finite feature take the tree's CONSTANT leaf
+        # value — the predict_tree contract; a clamped 3e38 would still
+        # explode through the linear term
+        finite_row = jnp.isfinite(X).all(axis=1)
+        Xc = jnp.nan_to_num(
+            X, nan=_F32_MAX, posinf=_F32_MAX, neginf=-_F32_MAX
+        )
+        Xm = _apply_mask(Xc, params["mask"])
         oh = leaf_one_hot(params["tree"], Xm, binned=False)
         # one-term exact selection of each row's coefficients
         beta_row = jax.lax.dot_general(
@@ -163,10 +190,35 @@ class LinearTreeRegressor(DecisionTreeRegressor):
             precision=(jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST),
         )  # [n, d+1]
         Xs = (Xm - params["x_mu"][None, :]) / params["x_sd"][None, :]
-        return jnp.sum(Xs * beta_row[:, :-1], axis=1) + beta_row[:, -1]
+        lin = jnp.sum(Xs * beta_row[:, :-1], axis=1) + beta_row[:, -1]
+        const = oh @ params["tree"].leaf_value[:, 0]
+        return jnp.where(finite_row, lin, const)
 
     def predict_many_fn(self, params, X):
-        return jax.vmap(lambda p: self.predict_fn(p, X))(params)
+        """Fused member predict: ONE column-select matmul routes every
+        member (``leaf_one_hot_forest``); only the small per-member linear
+        term remains batched elementwise — vmapping ``predict_fn`` would
+        re-stream X per member (the pattern ``predict_forest`` documents as
+        bandwidth-bound)."""
+        X = as_f32(X)
+        finite_row = jnp.isfinite(X).all(axis=1)  # [n]
+        Xc = jnp.nan_to_num(
+            X, nan=_F32_MAX, posinf=_F32_MAX, neginf=-_F32_MAX
+        )
+        oh = leaf_one_hot_forest(params["tree"], Xc, binned=False)  # [n,M,L]
+        beta_row = jnp.einsum(
+            "nml,mlD->nmD",
+            oh,
+            params["beta"],
+            precision=(jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST),
+        )  # [n, M, d+1]
+        Xs = (
+            Xc[:, None, :] * params["mask"][None, :, :]
+            - params["x_mu"][None, :, :]
+        ) / params["x_sd"][None, :, :]  # [n, M, d]
+        lin = jnp.sum(Xs * beta_row[:, :, :-1], axis=-1) + beta_row[:, :, -1]
+        const = jnp.einsum("nml,ml->nm", oh, params["tree"].leaf_value[:, :, 0])
+        return jnp.where(finite_row[:, None], lin, const).T  # [M, n]
 
     def feature_gains_fn(self, params, d: int):
         # importances come from the tree's split gains (the leaf models

@@ -906,6 +906,29 @@ def predict_forest(
         )
     if not fused or depth > _MATMUL_PREDICT_MAX_DEPTH:
         return jax.vmap(lambda t: predict_tree(t, X))(trees)
+    leaf_oh = leaf_one_hot_forest(trees, X, binned=False)  # [n, M, L]
+    # exact one-hot side single-term; value side HIGHEST (bit-exact)
+    out = jnp.einsum(
+        "nml,mlk->nmk",
+        leaf_oh,
+        trees.leaf_value,
+        precision=(jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST),
+    )
+    return jnp.moveaxis(out, 1, 0)  # [M, n, k]
+
+
+def leaf_one_hot_forest(trees: Tree, X: jax.Array, binned: bool) -> jax.Array:
+    """Exact leaf one-hot ``f32[n, M, 2^depth]`` for every member of a
+    stacked Tree in ONE column-select matmul + one path-scoring matmul —
+    the fused-member routing shared by ``predict_forest`` and the
+    linear-leaf learner's member predict."""
+    M, J = trees.split_feature.shape
+    depth = (J + 1).bit_length() - 1
+    if depth > _MATMUL_PREDICT_MAX_DEPTH:
+        raise ValueError(
+            f"leaf_one_hot_forest supports depth <= "
+            f"{_MATMUL_PREDICT_MAX_DEPTH}; got {depth}"
+        )
     n, d = X.shape
     Xc = jnp.nan_to_num(
         X.astype(jnp.float32), nan=_F32_MAX, posinf=_F32_MAX, neginf=-_F32_MAX
@@ -920,8 +943,11 @@ def predict_forest(
         # one-hot side single-term: bit-exact at half the passes
         precision=(jax.lax.Precision.HIGHEST, jax.lax.Precision.DEFAULT),
     )  # [n, M*J]
+    keys = (
+        trees.split_bin.astype(jnp.float32) if binned else trees.split_threshold
+    )
     bits = (
-        Xsel <= trees.split_threshold.reshape(M * J)[None, :]
+        Xsel <= keys.reshape(M * J)[None, :]
     ).astype(jnp.float32).reshape(n, M, J)
     C, c0 = _path_constants(depth)
     # both operands exactly bf16-representable small ints, f32 accumulation:
@@ -935,12 +961,4 @@ def predict_forest(
         )
         + jnp.asarray(c0)[None, None, :]
     )
-    leaf_oh = (score >= depth - 0.5).astype(jnp.float32)
-    # exact one-hot side single-term; value side HIGHEST (bit-exact)
-    out = jnp.einsum(
-        "nml,mlk->nmk",
-        leaf_oh,
-        trees.leaf_value,
-        precision=(jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST),
-    )
-    return jnp.moveaxis(out, 1, 0)  # [M, n, k]
+    return (score >= depth - 0.5).astype(jnp.float32)

@@ -123,3 +123,53 @@ def test_linear_tree_depth_capped():
 
     with _p.raises(ValueError):
         se.LinearTreeRegressor(max_depth=12)
+
+
+def test_nonfinite_features_stay_finite_and_fused_members_match_vmap():
+    """NaN/inf features clamp like predict_tree (no NaN leak through the
+    leaf linear term), and the fused member predict equals the per-member
+    path."""
+    import jax
+    import jax.numpy as jnp
+
+    X, y = _piecewise_linear(800)
+    m = se.LinearTreeRegressor(max_depth=2).fit(X, y)
+    Xbad = X[:50].copy()
+    Xbad[0, 0] = np.nan
+    Xbad[1, 1] = np.inf
+    Xbad[2, 2] = -np.inf
+    assert np.isfinite(np.asarray(m.predict(Xbad))).all()
+
+    bag = se.BaggingRegressor(
+        base_learner=se.LinearTreeRegressor(max_depth=2), num_base_learners=3
+    ).fit(X, y)
+    members = bag.params["members"]
+    est = se.LinearTreeRegressor(max_depth=2)
+    fused = np.asarray(est.predict_many_fn(members, jnp.asarray(X[:200])))
+    sliced = np.stack(
+        [
+            np.asarray(
+                est.predict_fn(
+                    jax.tree_util.tree_map(lambda x: x[i], members),
+                    jnp.asarray(X[:200]),
+                )
+            )
+            for i in range(3)
+        ]
+    )
+    np.testing.assert_allclose(fused, sliced, rtol=1e-5, atol=1e-5)
+
+
+def test_zero_min_leaf_weight_empty_leaves_fall_back():
+    """min_leaf_weight=0: a training-empty leaf must keep the constant
+    fallback, not an all-zero linear model."""
+    n = 512
+    X = np.zeros((n, 3), np.float32)
+    X[: n // 2, 0] = 1.0
+    y = (10.0 + X[:, 0]).astype(np.float32)
+    m = se.LinearTreeRegressor(max_depth=3, min_leaf_weight=0.0).fit(X, y)
+    # every training point predicts near its value; a probe row routed to
+    # an empty region must fall back to an ancestor mean (~10-11), not 0
+    probe = np.full((1, 3), 5.0, np.float32)
+    p = float(np.asarray(m.predict(probe))[0])
+    assert 9.0 < p < 12.0, p
