@@ -57,7 +57,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from spark_ensemble_tpu.models.base import (
     BaseLearner,
@@ -80,7 +80,6 @@ from spark_ensemble_tpu.parallel.mesh import (
     mesh_sizes as _mesh_sizes,
     pad_rows as _pad_rows,
     setup_row_sharding,
-    shard_ctx_rows,
     shard_fit_rows,
     shard_validation_rows,
 )
