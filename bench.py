@@ -33,16 +33,16 @@ _METRIC = "GBM boosting-iters/sec/chip (letter)"
 # PROTOCOL NOTE (round 3): timed fits now block on the model params.  The
 # earlier protocol timed only dispatch — jax's async dispatch let fit()
 # return ~5.8x before the CPU device work finished (measured round 3), so
-# pre-round-3 captures are dispatch rates, not compute rates.  The CPU
-# baseline below is the first HONEST capture; the TPU baseline keeps the
-# round-2 (biased-fast) number until a real-chip capture replaces it —
-# meaning a future TPU vs_baseline UNDERSTATES the true improvement.
+# pre-round-3 captures are dispatch rates, not compute rates.  Both
+# baselines below are blocking-protocol captures, so vs_baseline compares
+# like with like on either platform.
 _BASELINES = {
     # round 3 blocking-protocol capture, letter 20 rounds on CPU
     "cpu": 2.373,
-    # round 2, TPU v5 lite, letter 100 rounds, newton+line-search
-    # (BASELINE.md "Measured" table; pre-blocking protocol)
-    "tpu": 6.991,
+    # round 3 blocking-protocol real-chip capture, TPU v5 lite, letter
+    # 100 rounds, newton+line-search (BENCH_TPU_CAPTURE.json round 3;
+    # supersedes the round-2 dispatch-biased 6.991)
+    "tpu": 20.30,
 }
 
 
@@ -260,6 +260,19 @@ def _bench_full_extras():
     out = {}
     cpusmall = load_dataset("cpusmall")
     adult = load_dataset("adult")
+
+    # ONE stacking config for both the single-device and mesh timings —
+    # they must fit the same model or the comparison is meaningless
+    def stacking_fit(mesh=None):
+        return se.StackingClassifier(
+            base_learners=[
+                se.DecisionTreeClassifier(),
+                se.LogisticRegression(),
+                se.GaussianNaiveBayes(),
+            ],
+            stacker=se.LogisticRegression(),
+        ).fit(*adult, mesh=mesh)
+
     cases = {
         # BaggingRegressor(DT, 10) on cpusmall
         "bagging_cpusmall_fit_s": lambda: se.BaggingRegressor(
@@ -282,14 +295,7 @@ def _bench_full_extras():
             learning_rate=0.3,
         ).fit(*cpusmall),
         # StackingClassifier (DT + LR + NB, LR meta) on adult
-        "stacking_adult_fit_s": lambda: se.StackingClassifier(
-            base_learners=[
-                se.DecisionTreeClassifier(),
-                se.LogisticRegression(),
-                se.GaussianNaiveBayes(),
-            ],
-            stacker=se.LogisticRegression(),
-        ).fit(*adult),
+        "stacking_adult_fit_s": stacking_fit,
     }
     for name, fn in cases.items():
         try:
@@ -300,6 +306,29 @@ def _bench_full_extras():
             out[name] = round(_time.perf_counter() - t0, 3)
         except Exception as e:  # noqa: BLE001 - carry the error, keep going
             out[name + "_error"] = str(e)[:200]
+
+    # mesh-vs-single stacking: round-robin member placement only wins
+    # wall-clock with >1 device (models/stacking.py _fit_bases); on a
+    # single-chip run the field records why it was skipped
+    import jax
+
+    from spark_ensemble_tpu.parallel.mesh import data_member_mesh
+
+    if len(jax.devices()) > 1:
+        try:
+            mesh = data_member_mesh(len(jax.devices()), member=1)
+            mk = lambda: stacking_fit(mesh)  # noqa: E731
+            mk()  # warmup/compile
+            t0 = _time.perf_counter()
+            model = mk()
+            _block_on_model(model)
+            out["stacking_adult_mesh_fit_s"] = round(
+                _time.perf_counter() - t0, 3
+            )
+        except Exception as e:  # noqa: BLE001 - carry the error, keep going
+            out["stacking_adult_mesh_error"] = str(e)[:200]
+    else:
+        out["stacking_adult_mesh_note"] = "single device; mesh placement moot"
     return out
 
 

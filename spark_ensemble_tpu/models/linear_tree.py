@@ -85,10 +85,31 @@ class LinearTreeRegressor(DecisionTreeRegressor):
         )  # [n, d+1]
         oh = leaf_one_hot(tree, ctx["Xb"], binned=True)  # [n, leaves] exact
         Xw = Xs * w[:, None]
-        # every leaf's normal equations in two contractions (psum-ed):
-        A = preduce(jnp.einsum("nl,nd,ne->lde", oh, Xw, Xs), axis_name)
-        b = preduce(jnp.einsum("nl,nd,n->ld", oh, Xw, y), axis_name)
-        leaf_w = preduce(jnp.einsum("nl,n->l", oh, w), axis_name)
+        # every leaf's normal equations in two contractions (psum-ed); the
+        # batched Cholesky's inputs must not round to bf16 on TPU, so the
+        # statistics side runs at HIGHEST (the one-hot operand is exact at
+        # any precision, but 3-operand einsums take a single setting)
+        A = preduce(
+            jnp.einsum(
+                "nl,nd,ne->lde", oh, Xw, Xs,
+                precision=jax.lax.Precision.HIGHEST,
+            ),
+            axis_name,
+        )
+        b = preduce(
+            jnp.einsum(
+                "nl,nd,n->ld", oh, Xw, y,
+                precision=jax.lax.Precision.HIGHEST,
+            ),
+            axis_name,
+        )
+        leaf_w = preduce(
+            jnp.einsum(
+                "nl,n->l", oh, w,
+                precision=(jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST),
+            ),
+            axis_name,
+        )
         # penalize SLOPES only: an unpenalized intercept means a feature
         # that is constant WITHIN a leaf (collinear with the bias column)
         # gets slope exactly 0 instead of an arbitrary bias/slope split
@@ -191,7 +212,14 @@ class LinearTreeRegressor(DecisionTreeRegressor):
         )  # [n, d+1]
         Xs = (Xm - params["x_mu"][None, :]) / params["x_sd"][None, :]
         lin = jnp.sum(Xs * beta_row[:, :-1], axis=1) + beta_row[:, -1]
-        const = oh @ params["tree"].leaf_value[:, 0]
+        # keep the selected constants exact: one-hot side single-pass, value
+        # side HIGHEST — same discipline as beta_row / _predict_dense
+        const = jax.lax.dot_general(
+            oh,
+            params["tree"].leaf_value[:, 0],
+            (((1,), (0,)), ((), ())),
+            precision=(jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST),
+        )
         return jnp.where(finite_row, lin, const)
 
     def predict_many_fn(self, params, X):
@@ -217,7 +245,12 @@ class LinearTreeRegressor(DecisionTreeRegressor):
             - params["x_mu"][None, :, :]
         ) / params["x_sd"][None, :, :]  # [n, M, d]
         lin = jnp.sum(Xs * beta_row[:, :, :-1], axis=-1) + beta_row[:, :, -1]
-        const = jnp.einsum("nml,ml->nm", oh, params["tree"].leaf_value[:, :, 0])
+        const = jnp.einsum(
+            "nml,ml->nm",
+            oh,
+            params["tree"].leaf_value[:, :, 0],
+            precision=(jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST),
+        )
         return jnp.where(finite_row[:, None], lin, const).T  # [M, n]
 
     def feature_gains_fn(self, params, d: int):

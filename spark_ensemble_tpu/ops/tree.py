@@ -242,7 +242,7 @@ def fit_tree(
     parent_value = y_mean[None, :]  # [1, k] fallback values, updated per level
     prev_H = None  # previous level's histograms (fast-tier subtraction)
     prev_W = None  # previous level's node weights (tier-scaled floors)
-    prev_floor = None  # previous level's floors (carried forward, max)
+    prev_floor = None  # previous level's floors (accumulated along derived chains)
 
     for level in range(max_depth):
         n_nodes = 2**level
@@ -335,17 +335,21 @@ def fit_tree(
         parent_score = score(S[:, 0, 0, :], W[:, 0, 0])[:, None, None]
         gain = score(SL, WL) + score(SR, WR) - parent_score  # [nodes, d, B-1]
         if sub_path:
-            # floor relative to the TREE-PARENT's weight (the subtraction
-            # operands' magnitude) — the node's OWN derived W is ~noise for
-            # exactly the empty nodes the floor protects.  The parent's
-            # floor carries forward (max) so the chain cannot decay: an
-            # empty node's noisy weight would otherwise shrink its
-            # children's floor below THEIR inherited noise
-            tree_parent_w = jnp.repeat(prev_W, 2)  # [nodes]
-            node_floor = jnp.maximum(
-                _derived_hist_weight_floor(stat_prec, tree_parent_w),
-                jnp.repeat(prev_floor, 2),
-            )
+            # per-child floors: LEFT children are computed directly (an
+            # empty one-hot column dots to exactly 0.0 at any tier), so
+            # they take the direct-path floor; only the subtraction-derived
+            # RIGHT children inherit the parent's accumulated error plus
+            # this level's rounding at the parent's magnitude.  The sum
+            # bounds the error of the chain actually derived by
+            # subtraction (~depth * rel * local weight); a max() with the
+            # parent's floor would pin every descendant at rel * ROOT
+            # weight — a global cap on child size no tier intends
+            right_floor = prev_floor + _derived_hist_weight_floor(
+                stat_prec, prev_W
+            )  # [half]
+            node_floor = jnp.stack(
+                [jnp.full_like(right_floor, 1e-12), right_floor], axis=-1
+            ).reshape(n_nodes)
         else:
             node_floor = jnp.full((n_nodes,), 1e-12, jnp.float32)
         wf = node_floor[:, None, None]
@@ -565,7 +569,7 @@ def fit_forest(
     vals = jnp.concatenate([w[:, :, None], w[:, :, None] * Yc], axis=2)  # [n,M,1+k]
     prev_H = None  # previous level's histograms (fast-tier subtraction)
     prev_W = None  # previous level's node weights (tier-scaled floors)
-    prev_floor = None  # previous level's floors (carried forward, max)
+    prev_floor = None  # previous level's floors (accumulated along derived chains)
     fast_tier = stat_prec != jax.lax.Precision.HIGHEST
 
     for level in range(max_depth):
@@ -623,12 +627,15 @@ def fit_forest(
         parent_score = score(S[:, :, 0, 0, :], W[:, :, 0, 0])[:, :, None, None]
         gain = score(SL, WL) + score(SR, WR) - parent_score  # [M,nodes,d,B-1]
         if fast_tier and level >= 1:
-            # tree-parent-relative floor, carried forward (see fit_tree)
-            tree_parent_w = jnp.repeat(prev_W, 2, axis=1)  # [M, nodes]
-            node_floor = jnp.maximum(
-                _derived_hist_weight_floor(stat_prec, tree_parent_w),
-                jnp.repeat(prev_floor, 2, axis=1),
-            )
+            # per-child accumulated floors: direct LEFT children reset to
+            # the direct-path floor, derived RIGHT children accumulate
+            # (see fit_tree)
+            right_floor = prev_floor + _derived_hist_weight_floor(
+                stat_prec, prev_W
+            )  # [M, half]
+            node_floor = jnp.stack(
+                [jnp.full_like(right_floor, 1e-12), right_floor], axis=-1
+            ).reshape(M, n_nodes)
         else:
             node_floor = jnp.full((M, n_nodes), 1e-12, jnp.float32)
         wf = node_floor[:, :, None, None]

@@ -1,0 +1,60 @@
+"""Capture an on-chip jax.profiler trace of the BENCH_LARGE GBM config and
+print the per-op cost table (utils/profiling.py) — the trace-attribution
+workflow VERDICT round 3 asks for ("attack the MFU with the trace, not the
+estimate").
+
+Usage:  python tools/profile_large.py [trace_dir] [> PROFILE_TPU.md]
+
+Fits once for compile warmup (untraced), then traces a second fit of the
+same program, so the table shows steady-state device work, not compilation.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    trace_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/prof_large"
+    rounds = int(os.environ.get("BENCH_LARGE_ROUNDS", "20"))
+
+    import jax
+
+    from spark_ensemble_tpu import GBMClassifier
+    from spark_ensemble_tpu.utils import profiling
+
+    n, d, k = 131072, 32, 8
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, d).astype(np.float32)
+    centers = rng.randn(k, d).astype(np.float32)
+    y = np.argmax(X @ centers.T + 0.5 * rng.randn(n, k), axis=1).astype(
+        np.float32
+    )
+
+    est = GBMClassifier(
+        num_base_learners=rounds, loss="logloss", updates="newton",
+        learning_rate=0.3,
+    )
+    est.fit(X, y)  # warmup: compile outside the trace
+
+    est_traced = est.copy(profile_dir=trace_dir)
+    model = est_traced.fit(X, y)
+    from spark_ensemble_tpu.utils.instrumentation import block_on_arrays
+
+    block_on_arrays(model)
+
+    platform = jax.devices()[0].platform
+    print(f"# BENCH_LARGE trace (platform={platform}, n={n}, d={d}, k={k}, "
+          f"rounds={rounds})\n")
+    files = profiling.find_trace_files(trace_dir)
+    if not files:
+        print("no trace files captured")
+        return 1
+    rows, total = profiling.summarize_trace(trace_dir, top=40)
+    print(profiling.format_summary(rows, total))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
