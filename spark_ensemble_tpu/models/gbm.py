@@ -523,8 +523,9 @@ class GBMRegressor(_GBMParams):
                     pred, pred_val, delta = carry
                     bag_w, key, mask = xs
                     if huber:
-                        # shard-local |residual| + all_gather inside the
-                        # quantile: identical global delta on every shard
+                        # psum-ed histogram refinement inside the quantile
+                        # (no all_gather): identical global delta on every
+                        # shard with O(bins) communicated state
                         delta = weighted_quantile(
                             jnp.abs(y - pred), alpha_q, weights=valid_w,
                             axis_name=ax,

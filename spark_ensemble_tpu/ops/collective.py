@@ -24,3 +24,9 @@ def pmax_reduce(x, axis_name: Optional[str]):
     (Drucker boosting's distributed ``maxError``,
     `BoostingRegressor.scala:232-249`)."""
     return jax.lax.pmax(x, axis_name) if axis_name is not None else x
+
+
+def pmin_reduce(x, axis_name: Optional[str]):
+    """``pmin`` over ``axis_name`` inside shard_map; identity when unsharded
+    (brackets the distributed quantile refinement, `utils/quantile.py`)."""
+    return jax.lax.pmin(x, axis_name) if axis_name is not None else x

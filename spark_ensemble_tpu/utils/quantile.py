@@ -7,12 +7,21 @@ Reference semantics:
   at `GBMRegressor.scala:342-353` and DummyRegressor quantile strategy at
   `DummyRegressor.scala:119-125`).
 
-On TPU we compute quantiles *exactly* with a sort + cumulative-sum +
-searchsorted kernel — sorts are cheap in XLA at these scales, and exactness
-strictly dominates the reference's sketch approximation.  All kernels are
-jit/vmap-compatible (static shapes) and accept an optional mesh axis name for
-data-sharded inputs (values are all-gathered; quantiles are O(n log n) on the
-gathered vector which is fine for per-round scalar statistics).
+Local (unsharded) inputs use an exact sort + cumulative-sum + searchsorted
+kernel — sorts are cheap in XLA at these scales and exactness strictly
+dominates the reference's sketch approximation.
+
+Sharded inputs (``axis_name`` set, inside shard_map) must match the
+reference's scaling contract: `approxQuantile` is a STREAMING sketch — no
+executor ever holds the full column — so the mesh path here must not
+``all_gather`` the values either.  Instead it runs a fixed number of
+``psum``-ed histogram-refinement rounds over the monotone u32 *bit* space of
+the f32 values: 4 rounds x 256 bins resolve one of the 2^32 possible keys
+exactly, so the result is the same "first value whose global cumulative
+weight reaches the target" the exact kernel computes — communicated state is
+O(bins) per round, never O(n).  (An f32-value-space bisection could need ~30+
+rounds to separate values across binades; bit-space refinement is exact in 4
+by construction.)  All kernels are jit/vmap-compatible (static shapes).
 """
 
 from __future__ import annotations
@@ -21,6 +30,128 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from spark_ensemble_tpu.ops.collective import pmax_reduce, pmin_reduce, preduce
+
+# 4 rounds x 256-bin psum-ed histograms walk the full 2^32 u32 key space
+# down to a single key: 256^4 = 2^32 exactly.
+_BINS = 256
+_ROUNDS = 4
+
+# [n, _BINS] one-hot budget for the matmul histogram path (mirrors
+# `ops/tree.py _MATMUL_HIST_MAX_CELLS`); above it, scatter
+_HIST_MAX_CELLS = 2**28
+
+
+def _f32_keys(v: jax.Array) -> jax.Array:
+    """Monotone bijection f32 -> u32 (the radix-sort key trick): flip the
+    sign bit for non-negatives, all bits for negatives.  Total order matches
+    f32 comparison (with -0.0 keyed just below +0.0, and NaNs above +inf —
+    harmless here because NaN targets never carry weight)."""
+    b = jax.lax.bitcast_convert_type(v.astype(jnp.float32), jnp.int32)
+    return jnp.where(
+        b >= 0,
+        b.astype(jnp.uint32) + jnp.uint32(0x80000000),
+        (~b).astype(jnp.uint32),
+    )
+
+
+def _key_to_f32(u: jax.Array) -> jax.Array:
+    """Inverse of ``_f32_keys``."""
+    b = jnp.where(
+        u >= jnp.uint32(0x80000000),
+        (u - jnp.uint32(0x80000000)).astype(jnp.int32),
+        ~u.astype(jnp.int32),
+    )
+    return jax.lax.bitcast_convert_type(b, jnp.float32)
+
+
+def _sharded_crossing_key(values, weights, target, axis_name) -> jax.Array:
+    """u32 key of the first value whose GLOBAL cumulative weight >= target.
+
+    Each round buckets the shard's in-bracket keys into 256 equal key-space
+    bins (a one-hot contraction — MXU-friendly, no scatter), ``psum``s the
+    256 weights, picks the bin where the cumulative crosses ``target``, and
+    narrows the bracket to it; after 4 rounds the bracket is a single key.
+    The crossing bin always carries positive weight (its cumulative strictly
+    exceeds its predecessor's), so the result is an actual data value, and
+    zero-weight values can never be selected — the `Utils.scala:26-40` rule.
+    """
+    u = _f32_keys(values)
+    w = weights.astype(jnp.float32)
+
+    # same policy as the tree kernels (`ops/tree.py _resolve_hist`): the
+    # bin one-hot matmul is the MXU path, but its [n, bins] intermediate
+    # must stay bounded; above the cell budget fall back to segment_sum
+    # (scatter serializes on TPU but is O(bins) memory)
+    matmul_hist = values.shape[0] * _BINS <= _HIST_MAX_CELLS
+
+    def body(_, state):
+        lo, hi, cum_below = state
+        step = (hi - lo) // jnp.uint32(_BINS) + jnp.uint32(1)
+        rel = ((u - lo) // step).astype(jnp.int32)
+        in_bracket = (u >= lo) & (u <= hi)
+        if matmul_hist:
+            # out-of-bracket rows one-hot to class _BINS -> all-zero row
+            oh = jax.nn.one_hot(
+                jnp.where(in_bracket, rel, _BINS), _BINS, dtype=jnp.float32
+            )
+            hist = jnp.einsum(
+                "nb,n->b",
+                oh,
+                w,
+                precision=(
+                    jax.lax.Precision.DEFAULT,
+                    jax.lax.Precision.HIGHEST,
+                ),
+            )
+        else:
+            hist = jax.ops.segment_sum(
+                jnp.where(in_bracket, w, 0.0),
+                jnp.clip(rel, 0, _BINS - 1),
+                num_segments=_BINS,
+            )
+        hist = preduce(hist, axis_name)
+        cum = cum_below + jnp.cumsum(hist)
+        ge = cum >= target
+        # target can exceed the final cumulative by rounding slack (the
+        # total is summed in a different order than the histogram's cum);
+        # degrade to the bin CONTAINING hi — later rounds then converge on
+        # the data max, the exact kernel's clipped-index answer.  (Bin
+        # _BINS-1 would be wrong: it can lie past hi and invert the
+        # bracket into garbage.)
+        hi_bin = ((hi - lo) // step).astype(jnp.int32)
+        j = jnp.where(ge.any(), jnp.argmax(ge), hi_bin)
+        new_lo = lo + j.astype(jnp.uint32) * step
+        # saturate: the last bin's upper edge can wrap past 0xffffffff
+        hi_raw = new_lo + (step - jnp.uint32(1))
+        hi_raw = jnp.where(hi_raw < new_lo, jnp.uint32(0xFFFFFFFF), hi_raw)
+        new_hi = jnp.minimum(hi, hi_raw)
+        new_below = jnp.where(j > 0, cum[jnp.maximum(j - 1, 0)], cum_below)
+        return new_lo, new_hi, new_below
+
+    # bracket at the global data min/max: with target 0 (q=0) every bin
+    # satisfies the crossing test and the walk converges to the bracket's
+    # low edge — which must therefore be the minimum DATA value (the exact
+    # kernel's q=0 answer), not key 0 (a NaN bit pattern)
+    lo0 = _f32_keys(pmin_reduce(jnp.min(values), axis_name))
+    hi0 = _f32_keys(pmax_reduce(jnp.max(values), axis_name))
+    lo, hi, _ = jax.lax.fori_loop(
+        0, _ROUNDS, body, (lo0, hi0, jnp.float32(0.0))
+    )
+    return lo
+
+
+def _crossing_value_sharded(values, weights, q, axis_name) -> jax.Array:
+    total = preduce(jnp.sum(weights.astype(jnp.float32)), axis_name)
+    target = jnp.asarray(q, jnp.float32) * total
+    if target.ndim == 0:
+        key = _sharded_crossing_key(values, weights, target, axis_name)
+    else:
+        key = jax.vmap(
+            lambda t: _sharded_crossing_key(values, weights, t, axis_name)
+        )(target)
+    return _key_to_f32(key)
 
 
 def weighted_median(
@@ -31,12 +162,12 @@ def weighted_median(
     Matches `Utils.scala:26-40` exactly, including the >= comparison.
     Zero-weight entries cannot be selected unless they tie with the crossing
     point, mirroring the reference's behavior under its property tests.
-    With ``axis_name`` (inside shard_map) shards are all-gathered first so
-    every shard computes the identical global median.
+    With ``axis_name`` (inside shard_map) every shard computes the identical
+    global median via psum-ed histogram refinement — no shard ever holds the
+    full column (see module docstring).
     """
     if axis_name is not None:
-        values = jax.lax.all_gather(values, axis_name, tiled=True)
-        weights = jax.lax.all_gather(weights, axis_name, tiled=True)
+        return _crossing_value_sharded(values, weights, 0.5, axis_name)
     order = jnp.argsort(values)
     v = values[order]
     w = weights[order]
@@ -56,15 +187,16 @@ def weighted_quantile(
     """Exact weighted quantile(s) by sort + normalized cumulative weight.
 
     ``q`` may be a scalar or a vector of probabilities in [0, 1].  With
-    ``axis_name`` set (inside shard_map/pjit), shards are all-gathered first
-    so every device computes the identical global quantile — the SPMD
-    replacement for the reference's distributed ``approxQuantile``.
+    ``axis_name`` set (inside shard_map/pjit), every device computes the
+    identical global quantile via psum-ed histogram refinement over the f32
+    bit space — the SPMD replacement for the reference's distributed
+    ``approxQuantile``, with the same no-device-holds-the-column scaling
+    (and an exact result where the reference sketches).
     """
     if weights is None:
         weights = jnp.ones_like(values)
     if axis_name is not None:
-        values = jax.lax.all_gather(values, axis_name, tiled=True)
-        weights = jax.lax.all_gather(weights, axis_name, tiled=True)
+        return _crossing_value_sharded(values, weights, q, axis_name)
     order = jnp.argsort(values)
     v = values[order]
     w = weights[order]
