@@ -1,0 +1,71 @@
+"""Worker for the 2-process ``jax.distributed`` rendezvous test (run by
+``tests/test_multiprocess.py`` as a subprocess, once per process id).
+
+Joins the CPU rendezvous via ``parallel.multihost.initialize`` — the
+process_count>1 branch a single-process suite can never execute — builds a
+GLOBAL 4-device mesh (2 processes x 2 virtual CPU devices), and runs one
+psum-ed GBMRegressor fit step over it.  Prints ``MULTIHOST_OK`` only if the
+fitted params are finite and every cross-process collective completed.
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    port = sys.argv[1]
+    pid = int(sys.argv[2])
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from spark_ensemble_tpu.parallel import multihost
+
+    multihost.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=pid,
+    )
+    assert multihost.process_count() == 2, multihost.process_count()
+    assert multihost.process_index() == pid
+    assert len(jax.devices()) == 4, jax.devices()
+    assert multihost.local_device_count() == 2
+
+    # a raw cross-process psum first: the global mesh's collective seam
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from spark_ensemble_tpu.parallel.mesh import data_member_mesh
+
+    m = data_member_mesh(4, member=1)
+    x = np.arange(8, dtype=np.float32)
+    xs = jax.device_put(
+        x, jax.sharding.NamedSharding(m, P(("data",)))
+    )
+    total = shard_map(
+        lambda v: jax.lax.psum(jnp.sum(v), "data"),
+        mesh=m,
+        in_specs=P("data"),
+        out_specs=P(),
+    )(xs)
+    np.testing.assert_allclose(np.asarray(total), x.sum())
+
+    # one GBM fit step on the global mesh (psum-ed histograms/objective)
+    from spark_ensemble_tpu import GBMRegressor
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(512, 8).astype(np.float32)
+    y = (X @ rng.randn(8).astype(np.float32)).astype(np.float32)
+    model = GBMRegressor(num_base_learners=1).fit(X, y, mesh=m)
+    leaves = jax.tree_util.tree_leaves(model.params)
+    assert all(np.isfinite(np.asarray(leaf)).all() for leaf in leaves)
+
+    print("MULTIHOST_OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,61 @@
+"""Real multi-process execution: two OS processes join a ``jax.distributed``
+CPU rendezvous and run one psum-ed fit step over a GLOBAL mesh.
+
+This exercises the ``process_count > 1`` branch of ``parallel/multihost.py``
+— the only path that matters on a real pod — the way the reference exercises
+its distribution on ``local[*]`` with a real task scheduler (SURVEY.md §4).
+``tests/test_parallel.py`` covers the single-process contract; this file
+covers the rendezvous itself.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_rendezvous_psum_fit():
+    try:
+        port = _free_port()
+    except OSError as e:  # environment forbids sockets
+        pytest.skip(f"no loopback sockets: {e}")
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # the workers pin CPU + 2 virtual devices themselves; scrub any
+    # conflicting outer settings (e.g. this suite's 8-device conftest flags)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(port), str(pid)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multi-process rendezvous timed out (420s)")
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out[-3000:]}"
+        assert "MULTIHOST_OK" in out, f"process {pid} incomplete:\n{out[-3000:]}"
