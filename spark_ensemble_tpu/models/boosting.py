@@ -101,9 +101,20 @@ class _BoostingParams(CheckpointableParams, Estimator):
         "est_err >= 0.5, zero weight mass, perfect fit) are replayed on the "
         "host after each chunk, reproducing the per-round stopping exactly "
         "(post-stop rounds in the chunk are discarded).  Abort-prone "
-        "flavors ramp the chunk geometrically up to this cap so an early "
-        "abort discards at most ~the rounds already kept "
-        "(see _drive_boosting_rounds)",
+        "flavors probe with a single-round first chunk before jumping to "
+        "this cap (see _drive_boosting_rounds and the ramp param)",
+    )
+    ramp = Param(
+        "auto",
+        in_array(["auto", "off"]),
+        doc="chunk schedule for abort-prone flavors (discrete SAMME, "
+        "Drucker): 'auto' dispatches a single-round probe chunk first — an "
+        "abort on round 0 (the dominant abort case: a base learner too "
+        "weak or perfect on the ORIGINAL weights) then discards nothing — "
+        "and jumps straight to scan_chunk once the probe survives; 'off' "
+        "always dispatches full chunks (no probe overhead, up to "
+        "scan_chunk - 1 discarded fits on an abort).  SAMME.R has no "
+        "error-threshold abort and always runs full chunks",
     )
     checkpoint_interval = Param(10, gt_eq(1))
     checkpoint_dir = Param(
@@ -136,22 +147,27 @@ class _BoostingParams(CheckpointableParams, Estimator):
         final round count.
 
         ``ramp``: abort-prone flavors (discrete SAMME, Drucker R2 — their
-        stopping rules fire routinely on weak learners) grow the chunk
-        geometrically 1, 2, 4, ... up to ``scan_chunk``.  An abort ends the
-        fit and discards the rest of the in-flight chunk, so a fixed chunk
-        wastes up to ``scan_chunk - 1`` base fits on the final dispatch;
-        the ramp bounds the discarded work by the work kept while adding
-        only ~log2(scan_chunk) extra dispatches to long abort-free runs.
-        SAMME.R has no error-threshold abort, so it keeps the fixed chunk."""
+        stopping rules fire routinely on weak learners) dispatch a
+        single-round PROBE chunk first, then jump straight to
+        ``scan_chunk``.  An abort ends the fit and discards the rest of the
+        in-flight chunk; aborts overwhelmingly fire on round 0 (the base
+        learner is too weak — or perfect — on the original weights), so
+        the probe catches them with zero discard while abort-free runs pay
+        exactly ONE extra dispatch (the round-3 geometric 1,2,4,... ramp
+        cost ~log2(scan_chunk) dispatches on every abort-free fit — a
+        measured +15% on 10-round CPU stump boosting — for protection the
+        probe alone provides where it matters).  ``ramp='off'`` skips the
+        probe.  SAMME.R has no error-threshold abort and never probes."""
         i = start_i
         chunk = max(int(self.scan_chunk), 1)
         # a checkpoint resume starts at the full chunk: start_i kept rounds
         # already outweigh the worst-case discard of one fixed-size chunk
-        cur = 1 if (ramp and start_i == 0) else chunk
+        probe = ramp and self.ramp == "auto" and start_i == 0
+        cur = 1 if probe else chunk
         stop = float(jnp.sum(bw)) <= 0
         while i < self.num_base_learners and not stop:
             c = min(cur, self.num_base_learners - i)
-            cur = min(cur * 2, chunk)
+            cur = chunk  # probe survived (or no probe): full chunks from here
             if ckpt.enabled:
                 c = min(c, ckpt.rounds_until_save(i))
             keys = jax.vmap(lambda j: jax.random.fold_in(root, j))(

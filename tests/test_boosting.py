@@ -1,12 +1,14 @@
 """Boosting tests (mirrors `BoostingClassifierSuite.scala:52-154`,
 `BoostingRegressorSuite.scala:78-182`)."""
 
+import pytest
 import numpy as np
 
 import spark_ensemble_tpu as se
 from tests.conftest import accuracy, rmse, split
 
 
+@pytest.mark.slow
 def test_boosting_classifier_beats_single_tree(letter):
     X, y = letter
     Xtr, ytr, Xte, yte = split(X, y)
@@ -17,6 +19,7 @@ def test_boosting_classifier_beats_single_tree(letter):
     assert accuracy(boost.predict(Xte), yte) > accuracy(tree.predict(Xte), yte)
 
 
+@pytest.mark.slow
 def test_prefix_models_mostly_improve(letter):
     """Monotone-improvement archetype (`BoostingClassifierSuite.scala:52-91`):
     >= 0.8 of the prefix steps must not degrade accuracy."""
@@ -32,6 +35,7 @@ def test_prefix_models_mostly_improve(letter):
     assert accs[-1] > accs[0]
 
 
+@pytest.mark.slow
 def test_samme_and_samme_r_close(letter_full):
     """`BoostingClassifierSuite.scala:93-124`: SAMME ~= SAMME.R (reference
     asserts +-0.02 with depth-10 Spark trees; our complete-layout trees give
@@ -50,6 +54,7 @@ def test_samme_and_samme_r_close(letter_full):
     assert abs(a - b) < 0.06
 
 
+@pytest.mark.slow
 def test_raw_predictions_sum_to_zero(letter):
     """Symmetric-constraint invariant (`BoostingClassifierSuite.scala:126-154`)."""
     X, y = letter
@@ -62,6 +67,7 @@ def test_raw_predictions_sum_to_zero(letter):
         assert np.allclose(raw.sum(-1), 0.0, atol=1e-2 * np.abs(raw).max())
 
 
+@pytest.mark.slow
 def test_boosting_regressor_beats_single_tree(cpusmall):
     X, y = cpusmall
     Xtr, ytr, Xte, yte = split(X, y)
@@ -94,6 +100,7 @@ def test_degenerate_constant_labels_stop_early():
     assert np.allclose(np.asarray(boost.predict(X[:10])), 2.5, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_round_program_not_stale_after_set_params():
     """Regression (ADVICE r1): the cached round-step program must not read
     `self.loss` at retrace time.  Mutating one estimator's loss after fit
@@ -120,6 +127,7 @@ def test_round_program_not_stale_after_set_params():
     assert np.allclose(got, want, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_boosting_scan_chunk_invariance(letter, cpusmall):
     """Chunked dispatch must reproduce the per-round loop exactly — same
     member count (stop replay) and identical predictions — for both
@@ -164,16 +172,24 @@ class _SpyBoostingClassifier(se.BoostingClassifier):
         )
 
 
+@pytest.mark.slow
 def test_boosting_chunk_ramp_schedule(letter):
-    """Abort-prone discrete SAMME ramps the chunk 1, 2, 4, ... up to
-    scan_chunk; SAMME.R (no error-threshold abort) keeps the fixed chunk."""
+    """Abort-prone discrete SAMME dispatches a single-round probe chunk,
+    then full chunks (probe-then-full: one extra dispatch on abort-free
+    runs, zero discard on the dominant round-0 abort); ramp='off' skips
+    the probe; SAMME.R (no error-threshold abort) never probes."""
     X, y = letter
     Xs, ys = X[:1500], y[:1500]
     disc = _SpyBoostingClassifier(
         num_base_learners=10, scan_chunk=16, seed=2
     )
     disc.fit(Xs, ys)
-    assert disc.dispatched == [1, 2, 4, 3], disc.dispatched
+    assert disc.dispatched == [1, 9], disc.dispatched
+    off = _SpyBoostingClassifier(
+        num_base_learners=10, scan_chunk=16, seed=2, ramp="off"
+    )
+    off.fit(Xs, ys)
+    assert off.dispatched == [10], off.dispatched
     real = _SpyBoostingClassifier(
         algorithm="real", num_base_learners=10, scan_chunk=16, seed=2
     )
