@@ -1,12 +1,14 @@
 """Bagging tests (mirrors `BaggingRegressorSuite.scala:48-75`,
 `BaggingClassifierSuite.scala:48-182`)."""
 
+import pytest
 import numpy as np
 
 import spark_ensemble_tpu as se
 from tests.conftest import accuracy, rmse, split
 
 
+@pytest.mark.slow
 def test_bagging_regressor_beats_single_tree(cpusmall):
     X, y = cpusmall
     Xtr, ytr, Xte, yte = split(X, y)
@@ -21,6 +23,7 @@ def test_bagging_regressor_beats_single_tree(cpusmall):
     assert rmse(bag.predict(Xte), yte) < rmse(tree.predict(Xte), yte)
 
 
+@pytest.mark.slow
 def test_bagging_classifier_beats_single_tree_and_members(letter):
     X, y = letter
     Xtr, ytr, Xte, yte = split(X, y)
@@ -109,6 +112,7 @@ def test_member_plan_bit_identical_to_eager_loop():
         )
 
 
+@pytest.mark.slow
 def test_member_extraction_matches_member_predictions(letter):
     """model.member(i) is member i as a standalone fitted model (the
     reference models' `models` array); its predictions match the fused

@@ -56,7 +56,7 @@ def _mixed_values(rng, n):
 
 def test_mesh_quantile_matches_exact_kernel(mesh8):
     rng = np.random.RandomState(3)
-    for trial in range(5):
+    for trial in range(2):
         v = _mixed_values(rng, 4096)
         # quarter-integer weights: every partial sum is f32-exact, so the
         # mesh path's different accumulation order cannot shift near-ties
@@ -109,19 +109,39 @@ def test_mesh_quantile_never_gathers_the_column(mesh8):
     assert "all-reduce" in hlo  # the psum-ed histogram state
 
 
-def test_mesh_quantile_scatter_fallback_parity(mesh8, monkeypatch):
-    """Above the one-hot cell budget the histogram switches to segment_sum
-    (O(bins) memory); same exact result."""
+def test_mesh_quantile_matmul_and_scatter_hists_agree(mesh8, monkeypatch):
+    """The one-hot-matmul (accelerator) and segment_sum (CPU / above the
+    cell budget) histogram paths produce the same exact result.  CPU tests
+    default to scatter, so the matmul path is forced explicitly here."""
     import spark_ensemble_tpu.utils.quantile as qmod
 
-    monkeypatch.setattr(qmod, "_HIST_MAX_CELLS", 1)
     rng = np.random.RandomState(6)
     v = _mixed_values(rng, 2048)
     w = (rng.randint(0, 8, size=v.shape[0]) / 4.0).astype(np.float32)
-    for q in (0.1, 0.5, 0.9):
-        exact = float(weighted_quantile(jnp.asarray(v), q, jnp.asarray(w)))
-        got = float(_dist_quantile(mesh8, v, w, q))
-        assert got == exact, (q, exact, got)
+    for forced in (True, False):
+        monkeypatch.setattr(qmod, "_use_matmul_hist", lambda n: forced)
+        for q in (0.1, 0.5, 0.9):
+            exact = float(weighted_quantile(jnp.asarray(v), q, jnp.asarray(w)))
+            got = float(_dist_quantile(mesh8, v, w, q))
+            assert got == exact, (forced, q, exact, got)
+
+
+def test_mesh_quantile_zero_weight_nan_does_not_poison(mesh8):
+    """A NaN value masked out with weight 0 (how callers drop bad rows)
+    must not leak into the result — jnp.min/max would propagate it into
+    the bracket seed; the seed excludes NaNs instead."""
+    rng = np.random.RandomState(7)
+    v = rng.randn(512).astype(np.float32)
+    w = np.ones(512, np.float32)
+    v[17] = np.nan
+    w[17] = 0.0
+    exact = float(
+        weighted_quantile(
+            jnp.asarray(np.delete(v, 17)), 0.5, jnp.asarray(np.delete(w, 17))
+        )
+    )
+    got = float(_dist_quantile(mesh8, v, w, 0.5))
+    assert got == exact, (exact, got)
 
 
 def test_mesh_quantile_target_above_total_degrades_to_max(mesh8):

@@ -158,6 +158,7 @@ def test_binary_auc_tied_scores_give_chance_level():
     )
 
 
+@pytest.mark.slow
 def test_model_score_convenience():
     """model.score(X, y) == the corresponding evaluator's default metric
     (accuracy for classifiers, R^2 for regressors)."""

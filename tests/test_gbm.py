@@ -8,6 +8,7 @@ import spark_ensemble_tpu as se
 from tests.conftest import accuracy, rmse, split
 
 
+@pytest.mark.slow
 def test_gbm_regressor_beats_single_tree(cpusmall):
     X, y = cpusmall
     Xtr, ytr, Xte, yte = split(X, y)
@@ -46,6 +47,7 @@ def test_gbm_prefix_models_mostly_improve(cpusmall):
     assert improving / max(len(errs) - 1, 1) >= 0.8
 
 
+@pytest.mark.slow
 def test_gbm_early_stop_matches_offline_sweep(cpusmall):
     """`GBMRegressorSuite.scala:78-124`: the early-stopped member count equals
     the index an offline sweep of prefix models finds."""
@@ -81,6 +83,7 @@ def test_gbm_early_stop_matches_offline_sweep(cpusmall):
     assert gbm_es.num_members == expected_members
 
 
+@pytest.mark.slow
 def test_gbm_scan_chunk_invariance(cpusmall):
     """The scan-chunked round loop must produce the same model regardless of
     chunk size (chunk=1 is the per-round baseline): round math is identical,
@@ -99,6 +102,7 @@ def test_gbm_scan_chunk_invariance(cpusmall):
     np.testing.assert_allclose(preds[0], preds[2], rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_gbm_classifier_scan_chunk_invariance_with_validation(letter):
     """Chunked early stopping must pick the same stop round and members as
     per-round (chunk=1) fitting, including a mid-chunk stop."""
@@ -120,6 +124,7 @@ def test_gbm_classifier_scan_chunk_invariance_with_validation(letter):
     )
 
 
+@pytest.mark.slow
 def test_gbm_classifier_beats_single_tree_multiclass(letter):
     X, y = letter
     Xtr, ytr, Xte, yte = split(X, y)
@@ -130,6 +135,7 @@ def test_gbm_classifier_beats_single_tree_multiclass(letter):
     assert accuracy(gbm.predict(Xte), yte) > accuracy(tree.predict(Xte), yte)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("loss", ["bernoulli", "exponential"])
 def test_gbm_classifier_binary_losses(adult_full, loss):
     """`GBMClassifierSuite.scala:89-146` (binary, newton updates)."""
@@ -142,6 +148,7 @@ def test_gbm_classifier_binary_losses(adult_full, loss):
     assert accuracy(gbm.predict(Xte), yte) >= accuracy(tree.predict(Xte), yte) - 0.01
 
 
+@pytest.mark.slow
 def test_gbm_classifier_proba_shapes(letter):
     X, y = letter
     Xtr, ytr, Xte, _ = split(X, y)
@@ -188,6 +195,7 @@ def test_gbm_init_strategies(cpusmall):
         assert rmse(gbm.predict(Xte), yte) < base
 
 
+@pytest.mark.slow
 def test_gbm_classifier_validation_fold_missing_top_class():
     """Regression: the init DummyClassifier must be sized by the explicit
     class count even when the train split is missing the top class."""
@@ -216,6 +224,7 @@ def test_gbm_with_dummy_base_learner():
     assert np.all(np.isfinite(np.asarray(model.predict(X[:5]))))
 
 
+@pytest.mark.slow
 def test_gbm_classifier_binary_prior_with_no_positives_in_train():
     """Regression: explicit num_classes with zero train positives must give
     a finite (clamped) log-odds init, not -inf."""
@@ -275,6 +284,7 @@ def test_sampling_plan_bit_identical_to_eager_loop():
         )
 
 
+@pytest.mark.slow
 def test_validation_history_recorded(cpusmall):
     """Models fit with a validation split expose the per-round validation
     loss curve; its argmin-side structure matches the early-stop result:

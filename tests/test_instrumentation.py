@@ -4,6 +4,7 @@ import logging
 import os
 
 import numpy as np
+import pytest
 
 import spark_ensemble_tpu as se
 
@@ -51,6 +52,7 @@ def test_instrumented_logs_exceptions(caplog):
     assert "[boom.fit] failed" in caplog.text
 
 
+@pytest.mark.slow
 def test_trace_summary_from_profile_capture(tmp_path):
     """profile_dir capture -> utils.profiling summary: the op-cost table
     that drives kernel work must be producible from a fit's own trace."""

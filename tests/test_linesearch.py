@@ -45,6 +45,7 @@ def test_projected_newton_active_bound():
 import jax  # noqa: E402  (used by test_brent_nonconvex_finds_low_value)
 
 
+@pytest.mark.slow
 def test_closed_form_linesearch_grad_hess_matches_autodiff():
     """loss.linesearch_grad_hess == jax.grad/jax.hessian of the step-size
     objective, for every hessian-bearing loss; the Newton solve must land

@@ -35,6 +35,7 @@ def mesh():
     return create_mesh({"data": 4, "member": 2})
 
 
+@pytest.mark.slow
 def test_sharded_round_reduces_loss(mesh):
     X, y = _toy()
     est = GBMClassifier(
@@ -99,6 +100,7 @@ def test_pad_to_multiple():
     assert same.shape == (16, 3)
 
 
+@pytest.mark.slow
 def test_graft_entry_dryrun():
     import sys
 

@@ -25,6 +25,7 @@ def test_stacking_regressor_beats_weakest_member(cpusmall):
     assert stack_err < min(member_errs) * 1.1
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("method", ["class", "raw", "proba"])
 def test_stacking_classifier_stack_methods(letter, method):
     X, y = letter
@@ -48,6 +49,7 @@ def test_stacking_classifier_stack_methods(letter, method):
     assert accuracy(stack.predict(Xte), yte) >= min(member_accs) - 0.02
 
 
+@pytest.mark.slow
 def test_stacking_with_ensemble_members(letter):
     """The reference stacks meta-estimators as members
     (`StackingClassifierSuite.scala:49-87`: DT + Boosting + GBM + LR with a
@@ -97,6 +99,7 @@ def test_stacking_heterogeneous_regression_bases(cpusmall):
     assert rmse(stack.predict(Xte), yte) <= lin_err * 1.05
 
 
+@pytest.mark.slow
 def test_parallel_fits_match_sequential():
     """parallelism > 1 (thread-pool member fits, the reference's driver
     Futures) must produce identical models to sequential fitting."""

@@ -2,6 +2,7 @@
 
 import jax
 import jax.numpy as jnp
+import pytest
 import numpy as np
 
 from spark_ensemble_tpu.ops.binning import bin_features, compute_bins
@@ -540,6 +541,7 @@ def test_fast_tier_matmul_prefix_sums_metric_parity():
         assert abs(r_e - r_f) < 0.03 * max(r_e, r_f) + 1e-6, (m, r_e, r_f)
 
 
+@pytest.mark.slow
 def test_feature_importances_gain_based():
     """Gain-based importances (Spark `featureImportances` analogue): the
     only informative feature dominates; normalized to sum 1; members

@@ -114,6 +114,7 @@ def test_classifier_fit_rejects_bad_labels():
     assert m.predict_raw(X[:5]).shape == (5, 3)
 
 
+@pytest.mark.slow
 def test_feature_metadata_propagates_through_subspaces(tmp_path):
     """`Utils.getFeaturesMetadata` analogue (`Utils.scala:42-61`): names
     re-index through member subspace masks and survive save/load."""

@@ -6,9 +6,12 @@ module.exports = {
   tagline: 'Ensemble learning compiled to XLA: Bagging, Boosting, GBM, Stacking on TPU',
   url: 'https://example.github.io',
   baseUrl: '/spark-ensemble-tpu/',
-  favicon: 'img/favicon.ico',
   organizationName: 'spark-ensemble-tpu',
   projectName: 'spark-ensemble-tpu',
+  // docs are plain CommonMark (.md), not MDX — parse them as such
+  markdown: { format: 'detect' },
+  onBrokenLinks: 'warn',
+  onBrokenMarkdownLinks: 'warn',
   themeConfig: {
     navbar: {
       title: 'spark-ensemble-tpu',
