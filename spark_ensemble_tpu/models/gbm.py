@@ -916,7 +916,7 @@ class GBMClassifier(_GBMParams):
         def make_round_core():
             k_local = dim_blk // member_size
 
-            def round_core(ctx, X, y_enc, w, bag_w, key, mask, pred):
+            def round_core(ctx, X, y_enc, w, bag_w, key, mask, pred, alpha_ws):
                 labels, fit_w = _pseudo_residuals_and_weights(
                     loss, updates, y_enc, pred, bag_w, w, axis_name=ax
                 )
@@ -966,9 +966,15 @@ class GBMClassifier(_GBMParams):
                         )
                     else:
                         gh = None
+                    # warm start from the previous round's converged step
+                    # sizes (carried through the scan): consecutive rounds'
+                    # objectives are near-identical, so Newton typically
+                    # re-converges in 1-2 iterations instead of ~5 from
+                    # all-ones — the line-search small-op tail is a
+                    # measured slice of the device round (BASELINE.md)
                     alpha_opt = projected_newton_box(
                         phi,
-                        jnp.ones((dim,), jnp.float32),
+                        alpha_ws,
                         max_iter=min(max_iter, 25),
                         tol=tol,
                         axis_name=ax,
@@ -978,7 +984,7 @@ class GBMClassifier(_GBMParams):
                     alpha_opt = jnp.ones((dim,), jnp.float32)
                 weight = lr * alpha_opt
                 new_pred = pred + weight[None, :] * directions
-                return params, weight, new_pred
+                return params, weight, new_pred, alpha_opt
 
             return round_core
 
@@ -989,13 +995,13 @@ class GBMClassifier(_GBMParams):
             the chunk; round math identical to the per-round path)."""
             round_core = make_round_core()
 
-            def chunk(ctx, X, y_enc, w, pred, pred_val, X_val_a, y_enc_val_a,
-                      bag_ws, keys, masks):
+            def chunk(ctx, X, y_enc, w, pred, pred_val, alpha_ws, X_val_a,
+                      y_enc_val_a, bag_ws, keys, masks):
                 def body(carry, xs):
-                    pred, pred_val = carry
+                    pred, pred_val, alpha_ws = carry
                     bag_w, key, mask = xs
-                    params, weight, new_pred = round_core(
-                        ctx, X, y_enc, w, bag_w, key, mask, pred
+                    params, weight, new_pred, alpha_ws = round_core(
+                        ctx, X, y_enc, w, bag_w, key, mask, pred, alpha_ws
                     )
                     if with_validation:
                         dirs_val = jax.vmap(
@@ -1006,12 +1012,14 @@ class GBMClassifier(_GBMParams):
                     else:
                         new_pred_val = pred_val
                         err = jnp.float32(0)
-                    return (new_pred, new_pred_val), (params, weight, err)
+                    return (new_pred, new_pred_val, alpha_ws), (params, weight, err)
 
-                (pred, pred_val), (params_all, weights_all, errs) = jax.lax.scan(
-                    body, (pred, pred_val), (bag_ws, keys, masks)
+                (pred, pred_val, alpha_ws), (params_all, weights_all, errs) = (
+                    jax.lax.scan(
+                        body, (pred, pred_val, alpha_ws), (bag_ws, keys, masks)
+                    )
                 )
-                return params_all, weights_all, errs, pred, pred_val
+                return params_all, weights_all, errs, pred, pred_val, alpha_ws
 
             return jax.jit(chunk)
 
@@ -1025,13 +1033,13 @@ class GBMClassifier(_GBMParams):
             (`GBMRegressor.scala:444-465`)."""
             round_core = make_round_core()
 
-            def chunk(ctx, X, y_enc, w, pred, pred_val, X_val_a,
+            def chunk(ctx, X, y_enc, w, pred, pred_val, alpha_ws, X_val_a,
                       y_enc_val_a, valid_val, bag_ws, keys, masks):
                 def body(carry, xs):
-                    pred, pred_val = carry
+                    pred, pred_val, alpha_ws = carry
                     bag_w, key, mask = xs
-                    params, weight, new_pred = round_core(
-                        ctx, X, y_enc, w, bag_w, key, mask, pred
+                    params, weight, new_pred, alpha_ws = round_core(
+                        ctx, X, y_enc, w, bag_w, key, mask, pred, alpha_ws
                     )
                     if with_validation:
                         dirs_val = jax.vmap(
@@ -1051,12 +1059,14 @@ class GBMClassifier(_GBMParams):
                     else:
                         new_pred_val = pred_val
                         err = jnp.float32(0)
-                    return (new_pred, new_pred_val), (params, weight, err)
+                    return (new_pred, new_pred_val, alpha_ws), (params, weight, err)
 
-                (pred, pred_val), (params_all, weights_all, errs) = (
-                    jax.lax.scan(body, (pred, pred_val), (bag_ws, keys, masks))
+                (pred, pred_val, alpha_ws), (params_all, weights_all, errs) = (
+                    jax.lax.scan(
+                        body, (pred, pred_val, alpha_ws), (bag_ws, keys, masks)
+                    )
                 )
-                return params_all, weights_all, errs, pred, pred_val
+                return params_all, weights_all, errs, pred, pred_val, alpha_ws
 
             return jax.jit(
                 shard_map(
@@ -1069,6 +1079,7 @@ class GBMClassifier(_GBMParams):
                         P(ax),  # w
                         P(ax, None),  # pred
                         P(ax, None),  # pred_val
+                        P(),  # alpha_ws (replicated; psum-consistent)
                         P(ax, None),  # X_val
                         P(ax, None),  # y_enc_val
                         P(ax),  # valid_val
@@ -1082,6 +1093,7 @@ class GBMClassifier(_GBMParams):
                         P(),
                         P(ax, None),
                         P(ax, None),
+                        P(),  # alpha_ws
                     ),
                     check_vma=False,
                 )
@@ -1144,6 +1156,10 @@ class GBMClassifier(_GBMParams):
         weights_chunks: List[Any] = []
         val_history: List[float] = []
         i, v = 0, 0
+        # line-search warm start, carried across rounds AND checkpoints
+        # (a resume must replay the same Newton trajectory as an
+        # uninterrupted fit)
+        alpha_ws = jnp.ones((dim,), jnp.float32)
 
         # n_pad AND nv_pad in the identity: see GBMRegressor — padded
         # `pred`/`pred_val` must not be resumed under a different topology
@@ -1153,6 +1169,8 @@ class GBMClassifier(_GBMParams):
             last_round, st = resumed
             i, v, best = last_round + 1, int(st["v"]), float(st["best"])
             val_history[:] = [float(x) for x in np.asarray(st.get("val_hist", []))]
+            if "alpha_ws" in st:
+                alpha_ws = jnp.asarray(st["alpha_ws"])
             pred = jnp.asarray(st["pred"])
             if mesh is not None:
                 pred = jax.device_put(
@@ -1181,6 +1199,7 @@ class GBMClassifier(_GBMParams):
                     "val_hist": jnp.asarray(val_history, jnp.float32),
                     "pred": pred,
                     "pred_val": pred_val,
+                    "alpha_ws": alpha_ws,
                     "members_layout": self.MEMBERS_LAYOUT,
                     "members": concat_pytrees(members_chunks),
                     "weights": concat_pytrees(weights_chunks),
@@ -1188,15 +1207,18 @@ class GBMClassifier(_GBMParams):
             )
 
         def run_chunk(sl):
-            nonlocal pred, pred_val
+            nonlocal pred, pred_val, alpha_ws
             if mesh is not None:
-                params_c, weights_c, errs, pred, pred_val_new = chunk_step(
-                    ctx, X, y_enc, w, pred,
-                    pred_val if with_validation else val_dummy2,
-                    X_val if with_validation else val_dummy2,
-                    y_enc_val if with_validation else val_dummy2,
-                    valid_val,
-                    bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
+                params_c, weights_c, errs, pred, pred_val_new, alpha_ws = (
+                    chunk_step(
+                        ctx, X, y_enc, w, pred,
+                        pred_val if with_validation else val_dummy2,
+                        alpha_ws,
+                        X_val if with_validation else val_dummy2,
+                        y_enc_val if with_validation else val_dummy2,
+                        valid_val,
+                        bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
+                    )
                 )
                 if dim_blk != dim:
                     # drop the phantom tail trees: the fitted model's
@@ -1205,12 +1227,15 @@ class GBMClassifier(_GBMParams):
                         lambda x: x[:, :dim], params_c
                     )
             else:
-                params_c, weights_c, errs, pred, pred_val_new = chunk_step(
-                    ctx, X, y_enc, w, pred,
-                    pred_val if with_validation else val_dummy,
-                    X_val if with_validation else val_dummy,
-                    y_enc_val if with_validation else val_dummy,
-                    bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
+                params_c, weights_c, errs, pred, pred_val_new, alpha_ws = (
+                    chunk_step(
+                        ctx, X, y_enc, w, pred,
+                        pred_val if with_validation else val_dummy,
+                        alpha_ws,
+                        X_val if with_validation else val_dummy,
+                        y_enc_val if with_validation else val_dummy,
+                        bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
+                    )
                 )
             if with_validation:
                 pred_val = pred_val_new

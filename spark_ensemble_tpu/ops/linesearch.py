@@ -124,8 +124,8 @@ def projected_newton_box(
 
     Active set = coordinates pinned at the bound with inward-pointing
     gradient; the Newton system is solved on the free set via masked
-    Cholesky-backed solve with a small ridge; steps are Armijo-backtracked
-    (candidate step sizes evaluated in one vmapped sweep).
+    Cholesky-backed solve with a small ridge; steps are backtracked with
+    first-success halving (usually one objective evaluation per iteration).
 
     Inside ``shard_map`` with data-sharded rows, pass the SHARD-LOCAL
     objective plus ``axis_name``: the value, gradient, and Hessian are each

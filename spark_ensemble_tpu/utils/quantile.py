@@ -38,16 +38,13 @@ from spark_ensemble_tpu.ops.collective import pmax_reduce, pmin_reduce, preduce
 _BINS = 256
 _ROUNDS = 4
 
-# [n, _BINS] one-hot budget for the matmul histogram path (mirrors
-# `ops/tree.py _MATMUL_HIST_MAX_CELLS`); above it, scatter
-_HIST_MAX_CELLS = 2**28
-
 
 def _f32_keys(v: jax.Array) -> jax.Array:
     """Monotone bijection f32 -> u32 (the radix-sort key trick): flip the
     sign bit for non-negatives, all bits for negatives.  Total order matches
-    f32 comparison (with -0.0 keyed just below +0.0, and NaNs above +inf —
-    harmless here because NaN targets never carry weight)."""
+    f32 comparison, with -0.0 keyed just below +0.0 and NaNs keyed above
+    +inf (the bracket seed in ``_sharded_crossing_key`` excludes NaNs so
+    they can never be walked to)."""
     b = jax.lax.bitcast_convert_type(v.astype(jnp.float32), jnp.int32)
     return jnp.where(
         b >= 0,
@@ -66,6 +63,16 @@ def _key_to_f32(u: jax.Array) -> jax.Array:
     return jax.lax.bitcast_convert_type(b, jnp.float32)
 
 
+def _use_matmul_hist(n: int) -> bool:
+    """Same policy as the tree kernels (`ops/tree.py _resolve_hist`, shared
+    budget constant): the bin one-hot matmul is the MXU path, but its
+    [n, bins] intermediate must stay bounded, and on CPU (where scatter is
+    fast) segment_sum wins outright."""
+    from spark_ensemble_tpu.ops.tree import _MATMUL_HIST_MAX_CELLS
+
+    return jax.default_backend() != "cpu" and n * _BINS <= _MATMUL_HIST_MAX_CELLS
+
+
 def _sharded_crossing_key(values, weights, target, axis_name) -> jax.Array:
     """u32 key of the first value whose GLOBAL cumulative weight >= target.
 
@@ -80,11 +87,7 @@ def _sharded_crossing_key(values, weights, target, axis_name) -> jax.Array:
     u = _f32_keys(values)
     w = weights.astype(jnp.float32)
 
-    # same policy as the tree kernels (`ops/tree.py _resolve_hist`): the
-    # bin one-hot matmul is the MXU path, but its [n, bins] intermediate
-    # must stay bounded; above the cell budget fall back to segment_sum
-    # (scatter serializes on TPU but is O(bins) memory)
-    matmul_hist = values.shape[0] * _BINS <= _HIST_MAX_CELLS
+    matmul_hist = _use_matmul_hist(values.shape[0])
 
     def body(_, state):
         lo, hi, cum_below = state
@@ -133,9 +136,17 @@ def _sharded_crossing_key(values, weights, target, axis_name) -> jax.Array:
     # bracket at the global data min/max: with target 0 (q=0) every bin
     # satisfies the crossing test and the walk converges to the bracket's
     # low edge — which must therefore be the minimum DATA value (the exact
-    # kernel's q=0 answer), not key 0 (a NaN bit pattern)
-    lo0 = _f32_keys(pmin_reduce(jnp.min(values), axis_name))
-    hi0 = _f32_keys(pmax_reduce(jnp.max(values), axis_name))
+    # kernel's q=0 answer), not key 0 (a NaN bit pattern).  NaNs are
+    # excluded from the seed (jnp.min/max would PROPAGATE one zero-weight
+    # NaN into the bracket and poison the result; the exact kernel sorts
+    # NaNs last where zero weight keeps them unselectable)
+    finite = ~jnp.isnan(values)
+    lo0 = _f32_keys(
+        pmin_reduce(jnp.min(jnp.where(finite, values, jnp.inf)), axis_name)
+    )
+    hi0 = _f32_keys(
+        pmax_reduce(jnp.max(jnp.where(finite, values, -jnp.inf)), axis_name)
+    )
     lo, hi, _ = jax.lax.fori_loop(
         0, _ROUNDS, body, (lo0, hi0, jnp.float32(0.0))
     )
