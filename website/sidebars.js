@@ -7,6 +7,7 @@ module.exports = {
       'gbm',
       'stacking',
       'selection',
+      'distributed',
       'example',
     ],
   },
