@@ -455,6 +455,7 @@ def inner():
 
     train_acc = float(np.mean(np.asarray(model.predict(Xd)) == y))
 
+    platform = jax.devices()[0].platform
     extras = {}
     if os.environ.get("BENCH_FULL") == "1":
         extras = _bench_full_extras()
@@ -464,8 +465,15 @@ def inner():
         # one run captures the whole hist_precision comparison (a TPU
         # window is perishable; see BASELINE.md): re-fit at the OTHER
         # tiers — the main number above already covers hist_precision —
-        # and report their round rates + accuracy deltas
-        for tier in ("highest", "high", "default"):
+        # and report their round rates + accuracy deltas.  The pallas
+        # kernel tier only COMPILES on TPU (every other backend runs it
+        # in Python-level interpret mode, which would hang at bench
+        # scale), so it rides the comparison exactly when a TPU window
+        # is open
+        tiers = ("highest", "high", "default") + (
+            ("pallas",) if platform == "tpu" else ()
+        )
+        for tier in tiers:
             if tier == hist_precision:
                 continue
             try:
@@ -485,7 +493,6 @@ def inner():
                 extras[f"tier_{tier}_error"] = str(e)[:200]
 
     flops = _flops_per_round(X.shape[0], X.shape[1], 26, 5, 64)
-    platform = jax.devices()[0].platform
     out = {
         "metric": _METRIC,
         "value": round(iters_per_sec, 3),

@@ -40,12 +40,16 @@ class _TreeLearner(BaseLearner):
     min_info_gain = Param(0.0, gt_eq(0.0))
     hist_precision = Param(
         "highest",
-        in_array(["highest", "high", "default"]),
+        in_array(["highest", "high", "default", "pallas"]),
         doc="MXU precision of the histogram/leaf statistic matmuls: "
         "'highest' = exact f32 (6 bf16 passes, bit-equal to scatter); "
         "'high' = 3-pass bf16x3 (~f32 mantissa); 'default' = single-pass "
         "bf16 (fastest — statistics carry ~3 decimal digits, like a "
-        "subsampled histogram).  Routing stays exact on every setting.",
+        "subsampled histogram); 'pallas' = fused-member level histograms "
+        "as a VMEM-resident pallas kernel (ops/pallas_hist.py, 2-pass "
+        "hi/lo ~16-bit statistics, no bin-one-hot HBM operand; TPU "
+        "backends — elsewhere it runs interpreted, tests only).  Routing "
+        "stays exact on every setting.",
     )
     seed = Param(0)
 

@@ -1,0 +1,143 @@
+"""Pallas TPU kernel for the forest level-histogram build.
+
+The XLA matmul path (`ops/tree.py fit_forest`) materializes two large HBM
+operands per level and re-streams them on every MXU pass:
+
+- ``A [n, M*nodes*(1+k)]`` — the node-one-hot times (w, w*y) channels
+  (~50 MB at letter scale, level 4);
+- ``bin_oh [n, d*B]`` — the loop-invariant row-to-bin one-hot (61 MB at
+  letter scale, **1 GB** at the BENCH_LARGE config).
+
+This kernel fuses both away: each grid step DMAs only the COMPACT inputs
+(binned features ``i32[blk, d]``, node ids ``i32[blk, M]``, value channels
+``f32[blk, M, C]``), builds both one-hots in VMEM, runs the same
+``A^T @ bin_oh`` contraction on the MXU, and accumulates the histogram in a
+VMEM-resident output across the sequential grid — HBM traffic drops from
+O(n * d * B) per pass to O(n * (d + M*C)) per level.
+
+Precision: the value channels split into bf16 hi + lo terms (two MXU
+passes, ~16-bit statistic mantissa — between the 'default' (8-bit) and
+'high' (~24-bit) matmul tiers).  The one-hot side is exact 0/1 bf16.
+Empty nodes dot to exactly 0.0 (an all-zero one-hot column), so — unlike
+the histogram-subtraction fast tiers — no derived-noise weight floor is
+needed: every level is computed directly.
+
+Used by ``fit_forest`` when ``hist_precision="pallas"`` (TPU backends; any
+other backend runs the kernel in interpreter mode, which is only suitable
+for the small shapes the parity tests use).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# rows per grid step: bounds VMEM (block one-hots + hi/lo operands) while
+# keeping the MXU contraction dimension >= 2 tiles
+_BLOCK_ROWS = 256
+
+# VMEM budget for the resident accumulator + per-block operands (bytes);
+# configs over this fall back to the XLA matmul path (decided at trace
+# time from static shapes in ops/tree.py)
+_VMEM_BUDGET = 12 * 2**20
+
+
+def _interpret() -> bool:
+    """Interpreter mode off-TPU: correctness-only (tests use tiny shapes)."""
+    try:
+        return jax.devices()[0].platform != "tpu"
+    except Exception:  # noqa: BLE001 - no backend at all
+        return True
+
+
+def hist_vmem_bytes(n_nodes: int, M: int, C: int, d: int, B: int) -> int:
+    """Static VMEM estimate for the accumulator + block operands."""
+    acc = M * n_nodes * C * d * B * 4
+    rhs = _BLOCK_ROWS * d * B * 2
+    lhs = _BLOCK_ROWS * M * n_nodes * C * (4 + 2 + 2)
+    return acc + rhs + lhs
+
+
+def _hist_kernel(xb_ref, node_ref, vals_ref, out_ref, *, n_nodes, B):
+    """One grid step: accumulate this row block's histogram contribution.
+
+    Shapes (VMEM blocks): xb i32[blk, d], node i32[blk, M],
+    vals f32[blk, M, C], out f32[M*n_nodes*C, d*B] (revisited every step).
+    """
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    xb = xb_ref[:]
+    node = node_ref[:]
+    vals = vals_ref[:]
+    blk, d = xb.shape
+    _, M, C = vals.shape
+
+    # row-to-bin one-hot, built in VMEM (exact 0/1 in bf16)
+    bins = jax.lax.broadcasted_iota(jnp.int32, (blk, d, B), 2)
+    rhs = (xb[:, :, None] == bins).astype(jnp.bfloat16).reshape(blk, d * B)
+
+    # node-one-hot x value channels -> A block [blk, M*n_nodes*C]
+    nodes_iota = jax.lax.broadcasted_iota(jnp.int32, (blk, M, n_nodes), 2)
+    noh = (node[:, :, None] == nodes_iota).astype(jnp.float32)
+    lhs = (noh[:, :, :, None] * vals[:, :, None, :]).reshape(
+        blk, M * n_nodes * C
+    )
+    # two-pass hi/lo split: bf16 inputs on the MXU, f32 accumulate
+    hi = lhs.astype(jnp.bfloat16)
+    lo = (lhs - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+
+    contract = (((0,), (0,)), ((), ()))
+    acc = jax.lax.dot_general(
+        hi, rhs, contract, preferred_element_type=jnp.float32
+    )
+    acc = acc + jax.lax.dot_general(
+        lo, rhs, contract, preferred_element_type=jnp.float32
+    )
+    out_ref[:] += acc
+
+
+@functools.partial(jax.jit, static_argnames=("n_nodes", "max_bins"))
+def hist_level_pallas(Xb, node, vals, *, n_nodes: int, max_bins: int):
+    """Level histogram ``H f32[M, n_nodes, C, d, B]`` for all members.
+
+    ``Xb i32[n, d]`` shared binned features; ``node i32[n, M]`` each row's
+    node at this level per member; ``vals f32[n, M, C]`` the statistic
+    channels (w, w*y...).  Zero-weight (padding) rows contribute exactly 0.
+    """
+    n, d = Xb.shape
+    _, M, C = vals.shape
+    B = max_bins
+
+    pad = (-n) % _BLOCK_ROWS
+    if pad:
+        # padded rows: vals 0 -> zero contribution regardless of node/bin
+        Xb = jnp.pad(Xb, ((0, pad), (0, 0)))
+        node = jnp.pad(node, ((0, pad), (0, 0)))
+        vals = jnp.pad(vals, ((0, pad), (0, 0), (0, 0)))
+    steps = (n + pad) // _BLOCK_ROWS
+
+    kernel = functools.partial(_hist_kernel, n_nodes=n_nodes, B=B)
+    out = pl.pallas_call(
+        kernel,
+        grid=(steps,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, d), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, M), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, M, C), lambda i: (i, 0, 0)),
+        ],
+        # constant index map: the accumulator stays VMEM-resident and is
+        # revisited (+=) by every sequential grid step
+        out_specs=pl.BlockSpec(
+            (M * n_nodes * C, d * B), lambda i: (0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((M * n_nodes * C, d * B), jnp.float32),
+        interpret=_interpret(),
+    )(Xb, node, vals)
+    return out.reshape(M, n_nodes, C, d, B)

@@ -96,6 +96,11 @@ _HIST_PRECISION = {
     "highest": jax.lax.Precision.HIGHEST,  # 6-pass bf16 emulation of f32
     "high": jax.lax.Precision.HIGH,  # 3-pass bf16x3 (~f32 mantissa)
     "default": jax.lax.Precision.DEFAULT,  # single-pass bf16 inputs
+    # pallas tier (fit_forest only): the level histogram runs as a pallas
+    # kernel (ops/pallas_hist.py, 2-pass hi/lo ~16-bit statistics); every
+    # OTHER statistic matmul (prefix sums, leaf stage, single-tree
+    # fallback) runs at the 'high' setting
+    "pallas": jax.lax.Precision.HIGH,
 }
 
 
@@ -515,7 +520,25 @@ def fit_forest(
     _, M, k = Y.shape
     B = max_bins
     num_internal = 2**max_depth - 1
-    hist = _resolve_hist(hist, n, d, B)
+    # pallas tier: the level histogram runs as a VMEM-resident pallas
+    # kernel (ops/pallas_hist.py) — no bin_oh / A-matrix HBM operands.
+    # Falls back to the 'high' matmul tier when the accumulator would not
+    # fit the kernel's VMEM budget (static shapes, decided here).
+    pallas_tier = hist_precision.lower() == "pallas"
+    if pallas_tier:
+        from spark_ensemble_tpu.ops.pallas_hist import (
+            _VMEM_BUDGET,
+            hist_vmem_bytes,
+        )
+
+        hist = "matmul"  # the fused path below hosts the pallas kernel
+        if (
+            hist_vmem_bytes(2 ** (max_depth - 1), M, 1 + k, d, B)
+            > _VMEM_BUDGET
+        ):
+            pallas_tier = False
+    else:
+        hist = _resolve_hist(hist, n, d, B)
     # case-normalized here (not at the Param) so direct kernel callers get
     # the same tolerance as estimator users
     stat_prec = _HIST_PRECISION[hist_precision.lower()]
@@ -526,7 +549,15 @@ def fit_forest(
     elif feature_mask.ndim == 1:
         feature_mask = jnp.broadcast_to(feature_mask[None, :], (M, d))
 
-    fused_cells = n * M * 2 ** (max_depth - 1) * (1 + k)
+    # budget the fused path by its LARGEST [n, M, ...] intermediate: the
+    # A-matrix build for the matmul tiers; only the routing one-hot
+    # [n, M, nodes] for the pallas tier (its histogram never materializes
+    # A or bin_oh — that is the point of the kernel), which extends the
+    # fused range by (1 + k)x before falling back to per-tree fits
+    if pallas_tier:
+        fused_cells = n * M * 2 ** (max_depth - 1)
+    else:
+        fused_cells = n * M * 2 ** (max_depth - 1) * (1 + k)
     if hist != "matmul" or fused_cells > _FOREST_FUSED_MAX_CELLS:
         # scatter backend (CPU) or over-budget fused build: per-tree path
         fit_one = lambda Ym, wm, fm: fit_tree(
@@ -553,11 +584,14 @@ def fit_forest(
     )  # [M, k]
     Yc = Y - y_mean[None, :, :]
 
-    bin_oh = (
-        (Xb[:, :, None] == jnp.arange(B, dtype=Xb.dtype))
-        .astype(jnp.float32)
-        .reshape(n, d * B)
-    )
+    if not pallas_tier:
+        # loop-invariant row-to-bin one-hot; the pallas tier builds it
+        # per block in VMEM instead of materializing [n, d*B] in HBM
+        bin_oh = (
+            (Xb[:, :, None] == jnp.arange(B, dtype=Xb.dtype))
+            .astype(jnp.float32)
+            .reshape(n, d * B)
+        )
 
     split_feature = jnp.zeros((M, num_internal), jnp.int32)
     split_bin = jnp.zeros((M, num_internal), jnp.int32)
@@ -570,7 +604,9 @@ def fit_forest(
     prev_H = None  # previous level's histograms (fast-tier subtraction)
     prev_W = None  # previous level's node weights (tier-scaled floors)
     prev_floor = None  # previous level's floors (accumulated along derived chains)
-    fast_tier = stat_prec != jax.lax.Precision.HIGHEST
+    # pallas computes every level DIRECTLY (empty nodes dot to exact 0.0),
+    # so it takes the exact path's floors, not the subtraction machinery
+    fast_tier = stat_prec != jax.lax.Precision.HIGHEST and not pallas_tier
 
     for level in range(max_depth):
         n_nodes = 2**level
@@ -596,6 +632,12 @@ def fit_forest(
             )
             Hr = prev_H - Hl
             H = jnp.stack([Hl, Hr], axis=2).reshape(M, n_nodes, 1 + k, d, B)
+        elif pallas_tier:
+            from spark_ensemble_tpu.ops.pallas_hist import hist_level_pallas
+
+            H = preduce(
+                hist_level_pallas(Xb, node, vals, n_nodes=n_nodes, max_bins=B)
+            )
         else:
             A = (node_oh[:, :, :, None] * vals[:, :, None, :]).reshape(
                 n, M * n_nodes * (1 + k)

@@ -1,0 +1,133 @@
+"""Pallas level-histogram tier (`ops/pallas_hist.py`, hist_precision=
+"pallas"): parity with the exact matmul tier on shapes where the 2-pass
+hi/lo split is exact, metric-level agreement elsewhere, and the static
+VMEM-budget fallback.  Off-TPU the kernel runs in interpreter mode, so
+every shape here is tiny."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import spark_ensemble_tpu as se
+from spark_ensemble_tpu.ops.binning import bin_features, compute_bins
+from spark_ensemble_tpu.ops.pallas_hist import hist_level_pallas
+from spark_ensemble_tpu.ops.tree import fit_forest
+
+
+def _binned(rng, n, d, B):
+    X = rng.randn(n, d).astype(np.float32)
+    bins = compute_bins(jnp.asarray(X), B)
+    return bin_features(jnp.asarray(X), bins), bins
+
+
+def test_kernel_matches_dense_reference():
+    """Histogram parity against a dense numpy reference, on value channels
+    whose hi/lo bf16 split is exact (small dyadic rationals)."""
+    rng = np.random.RandomState(0)
+    n, d, M, C, n_nodes, B = 500, 4, 3, 2, 4, 8
+    Xb, _ = _binned(rng, n, d, B)
+    node = rng.randint(0, n_nodes, size=(n, M)).astype(np.int32)
+    vals = (rng.randint(-8, 9, size=(n, M, C)) / 4.0).astype(np.float32)
+
+    H = np.asarray(
+        hist_level_pallas(
+            Xb, jnp.asarray(node), jnp.asarray(vals),
+            n_nodes=n_nodes, max_bins=B,
+        )
+    )
+    Xb_np = np.asarray(Xb)
+    ref = np.zeros((M, n_nodes, C, d, B), np.float32)
+    for i in range(n):
+        for m in range(M):
+            for f in range(d):
+                ref[m, node[i, m], :, f, Xb_np[i, f]] += vals[i, m]
+    np.testing.assert_allclose(H, ref, rtol=0, atol=1e-5)
+
+
+def test_padding_rows_contribute_nothing():
+    """n not a multiple of the block size: the kernel pads internally with
+    zero value channels, which must not perturb any bin."""
+    rng = np.random.RandomState(1)
+    n, d, M, C, B = 277, 3, 2, 2, 8  # prime n -> guaranteed padding
+    Xb, _ = _binned(rng, n, d, B)
+    node = rng.randint(0, 2, size=(n, M)).astype(np.int32)
+    vals = rng.randn(n, M, C).astype(np.float32)
+    H = np.asarray(
+        hist_level_pallas(Xb, jnp.asarray(node), jnp.asarray(vals),
+                          n_nodes=2, max_bins=B)
+    )
+    # total weight per member must equal the sum over the REAL rows
+    # (H[:, :, 0] is [M, nodes, d, B]; each row lands in one bin PER
+    # feature, so the grand total counts every row d times)
+    np.testing.assert_allclose(
+        H[:, :, 0].sum(axis=(1, 2, 3)) / d, vals[:, :, 0].sum(axis=0),
+        rtol=1e-4,
+    )
+
+
+def test_forest_fit_parity_with_exact_tier():
+    """Same splits and (f32-exact-input) leaf values as the exact matmul
+    tier on dyadic-rational weights/targets."""
+    rng = np.random.RandomState(2)
+    n, d, M, k, B = 600, 6, 3, 1, 16
+    Xb, bins = _binned(rng, n, d, B)
+    Y = (rng.randint(-16, 17, size=(n, M, k)) / 8.0).astype(np.float32)
+    w = (rng.randint(0, 3, size=(n, M)) / 2.0).astype(np.float32)
+    kw = dict(max_depth=3, max_bins=B)
+    exact = fit_forest(Xb, jnp.asarray(Y), jnp.asarray(w), bins.thresholds,
+                       hist_precision="highest", hist="matmul", **kw)
+    pallas = fit_forest(Xb, jnp.asarray(Y), jnp.asarray(w), bins.thresholds,
+                        hist_precision="pallas", **kw)
+    np.testing.assert_array_equal(
+        np.asarray(exact.split_feature), np.asarray(pallas.split_feature)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(exact.split_bin), np.asarray(pallas.split_bin)
+    )
+    np.testing.assert_allclose(
+        np.asarray(exact.leaf_value), np.asarray(pallas.leaf_value),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_gbm_with_pallas_tier_metric_parity():
+    rng = np.random.RandomState(3)
+    X = rng.randn(800, 8).astype(np.float32)
+    c = rng.randn(4, 8).astype(np.float32)
+    y = np.argmax(X @ c.T, axis=1).astype(np.float32)
+    cfg = dict(num_base_learners=3, learning_rate=0.5, seed=0)
+    a_hi = float(np.mean(np.asarray(
+        se.GBMClassifier(**cfg).fit(X, y).predict(X)) == y))
+    a_pl = float(np.mean(np.asarray(
+        se.GBMClassifier(
+            base_learner=se.DecisionTreeRegressor(hist_precision="pallas"),
+            **cfg,
+        ).fit(X, y).predict(X)) == y))
+    assert abs(a_hi - a_pl) < 0.02, (a_hi, a_pl)
+
+
+def test_vmem_budget_falls_back_to_matmul(monkeypatch):
+    """Configs whose accumulator exceeds the kernel's VMEM budget silently
+    take the 'high' matmul tier instead (static-shape decision)."""
+    import spark_ensemble_tpu.ops.pallas_hist as ph
+
+    monkeypatch.setattr(ph, "_VMEM_BUDGET", 1)
+    rng = np.random.RandomState(4)
+    n, d, M, k, B = 300, 4, 2, 1, 8
+    Xb, bins = _binned(rng, n, d, B)
+    Y = rng.randn(n, M, k).astype(np.float32)
+    w = np.ones((n, M), np.float32)
+    # must run (via the matmul fallback) and produce a sane forest
+    f = fit_forest(Xb, jnp.asarray(Y), jnp.asarray(w), bins.thresholds,
+                   hist_precision="pallas", max_depth=3, max_bins=B)
+    assert np.isfinite(np.asarray(f.leaf_value)).all()
+
+
+def test_pallas_persists_and_validates():
+    est = se.DecisionTreeRegressor(hist_precision="pallas")
+    assert est.hist_precision == "pallas"
+    try:
+        se.DecisionTreeRegressor(hist_precision="nope")
+        raise AssertionError("validator must reject unknown tiers")
+    except ValueError:
+        pass
