@@ -375,6 +375,25 @@ def _bench_large_extras():
             out["large_mfu_est"] = round(
                 flops * (rounds / fit_s) / _peak_flops(platform), 5
             )
+        if platform == "tpu":
+            # the pallas histogram tier's HBM win scales with n (the
+            # bin-one-hot it avoids streaming is ~1 GB here) — time it at
+            # the large config whenever a real chip can compile it
+            try:
+                from spark_ensemble_tpu import DecisionTreeRegressor
+
+                p_est = est.copy(
+                    base_learner=DecisionTreeRegressor(
+                        hist_precision="pallas"
+                    )
+                )
+                p_est.fit(X, y)  # warmup/compile
+                _, p_fit_s = _timed_fit(p_est, X, y)
+                out["large_pallas_iters_per_sec"] = round(
+                    rounds / p_fit_s, 3
+                )
+            except Exception as e:  # noqa: BLE001 - carry, keep going
+                out["large_pallas_error"] = str(e)[:200]
         return out
     except Exception as e:  # noqa: BLE001 - carry the error, keep going
         return {"large_error": str(e)[:200]}
