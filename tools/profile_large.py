@@ -53,6 +53,21 @@ def main() -> int:
         return 1
     rows, total = profiling.summarize_trace(trace_dir, top=40)
     print(profiling.format_summary(rows, total))
+
+    # ---- predict path (the second headline metric): steady-state reps ----
+    pred_dir = trace_dir + "_predict"
+    Xd = jax.numpy.asarray(X)
+    jax.block_until_ready(model.predict(Xd))  # compile outside the trace
+    with jax.profiler.trace(pred_dir):
+        for _ in range(10):
+            out = model.predict(Xd)
+        jax.block_until_ready(out)
+    print(f"\n# predict trace (10 reps, n={n})\n")
+    if not profiling.find_trace_files(pred_dir):
+        print("no predict trace files captured")
+        return 1
+    rows, total = profiling.summarize_trace(pred_dir, top=25)
+    print(profiling.format_summary(rows, total))
     return 0
 
 
