@@ -28,6 +28,14 @@ import pytest
 from spark_ensemble_tpu.utils import datasets as ds
 
 
+@pytest.fixture(scope="session")
+def data_mesh8():
+    """A plain 8-device ("data",) mesh over the virtual CPU devices."""
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()).reshape(8), ("data",))
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _bound_compiled_program_accumulation():
     """Free compiled XLA executables between test modules.

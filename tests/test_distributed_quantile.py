@@ -24,8 +24,8 @@ from spark_ensemble_tpu.utils.quantile import (
 
 
 @pytest.fixture(scope="module")
-def mesh8():
-    return Mesh(np.array(jax.devices()).reshape(8), ("data",))
+def mesh8(data_mesh8):
+    return data_mesh8
 
 
 def _dist_quantile(mesh, v, w, q):

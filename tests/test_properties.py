@@ -44,6 +44,50 @@ def test_weighted_median_scale_invariant(v, w, c):
     assert float(weighted_median(v, w)) == float(weighted_median(v, scale * w))
 
 
+_QSHARD = None
+
+
+@settings(max_examples=15, deadline=None)
+@given(
+    _int_vals,
+    # dyadic weights (k/32): every partial sum is f32-exact, so the mesh
+    # path's different accumulation order cannot shift near-tie crossings
+    st.lists(st.integers(1, 2**15), min_size=_N, max_size=_N),
+    # q as a scaled integer: st.floats trips the FTZ self-check (module
+    # docstring)
+    st.integers(0, 1000),
+)
+def test_sharded_quantile_matches_exact_kernel_property(
+    data_mesh8, v, w, q_milli
+):
+    """The mesh quantile (psum-ed bit-space histogram refinement, no
+    all_gather) equals the exact sort-based kernel for ANY weights and any
+    q — the property form of tests/test_distributed_quantile.py.  One
+    fixed shard_map program; every generated example reuses it."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from spark_ensemble_tpu.utils.quantile import weighted_quantile
+
+    global _QSHARD
+    if _QSHARD is None:
+        _QSHARD = jax.jit(
+            shard_map(
+                lambda vv, ww, qq: weighted_quantile(
+                    vv, qq, ww, axis_name="data"
+                ),
+                mesh=data_mesh8,
+                in_specs=(P("data"), P("data"), P()),
+                out_specs=P(),
+            )
+        )
+    v = _vals(v)
+    w = jnp.asarray(np.asarray(w, np.float32) / 32.0)
+    qj = jnp.float32(q_milli / 1000.0)
+    exact = float(weighted_quantile(v, qj, w))
+    assert float(_QSHARD(v, w, qj)) == exact
+
+
 @settings(max_examples=25, deadline=None)
 @given(_int_vals, _int_weights)
 def test_weighted_median_is_an_element_and_order_invariant(v, w):
