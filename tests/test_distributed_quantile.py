@@ -14,7 +14,6 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.experimental.shard_map import shard_map
-from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
 from spark_ensemble_tpu.utils.quantile import (
