@@ -127,8 +127,6 @@ def test_kernel_lowers_for_tpu(monkeypatch):
     """Cross-platform export: the REAL (non-interpret) kernel must lower
     through Mosaic for the TPU target at the benchmark shapes — the only
     TPU-compilation check a chipless CI can run."""
-    import functools
-
     from jax import export
 
     import spark_ensemble_tpu.ops.pallas_hist as ph
@@ -138,17 +136,19 @@ def test_kernel_lowers_for_tpu(monkeypatch):
         (15000, 16, 26, 2, 16, 64),  # letter headline, deepest level
         (1024, 8, 4, 2, 1, 16),  # level 0
     ):
-        fn = jax.jit(
-            functools.partial(
-                ph.hist_level_pallas, n_nodes=n_nodes, max_bins=B
-            )
-        )
-        exp = export.export(fn, platforms=("tpu",))(
+        # hist_level_pallas is already jit-wrapped with static_argnames
+        exp = export.export(ph.hist_level_pallas, platforms=("tpu",))(
             jnp.zeros((n, d), jnp.int32),
             jnp.zeros((n, M), jnp.int32),
             jnp.zeros((n, M, C), jnp.float32),
+            n_nodes=n_nodes,
+            max_bins=B,
         )
         assert "tpu_custom_call" in exp.mlir_module()
+    # the monkeypatched interpret=False decision is baked into the jit
+    # trace cache (its key ignores it); drop those traces so later tests
+    # with colliding shapes cannot dispatch a Mosaic kernel on CPU
+    jax.clear_caches()
 
 
 def test_pallas_persists_and_validates():
