@@ -131,7 +131,23 @@ def main():
             time.sleep(min(60 * (attempt + 1), 240))
 
     if ok:
-        result, err = _run_inner(dict(os.environ), inner_timeout)
+        # a green REAL-accelerator probe is a PERISHABLE window
+        # (BASELINE.md): arm the full battery so one window yields the
+        # headline AND the per-config/large/tier fields without anyone
+        # asking.  A green CPU-backend probe (no accelerator registered)
+        # is not a window — no arming.  Explicit BENCH_*=0 still disables
+        # a section.
+        env = dict(os.environ)
+        armed = not info.startswith("cpu")
+        if armed:
+            for knob in ("BENCH_FULL", "BENCH_LARGE", "BENCH_TIERS"):
+                env.setdefault(knob, "1")
+        result, err = _run_inner(env, inner_timeout)
+        if result is None and armed:
+            # the armed battery overran the timeout; the window may still
+            # be open — salvage the headline with a bare retry
+            errors.append(f"armed accelerator bench: {err}")
+            result, err = _run_inner(dict(os.environ), inner_timeout)
         if result is None:
             errors.append(f"accelerator bench: {err}")
         else:

@@ -72,6 +72,65 @@ def test_main_rejects_bad_tier_without_probing(monkeypatch, capsys):
     assert rc == 1 and "BENCH_HIST_PRECISION" in out["error"]
 
 
+def test_main_arms_full_battery_only_on_real_accelerator(
+    tmp_path, monkeypatch, capsys
+):
+    """A green REAL-accelerator probe arms BENCH_FULL/LARGE/TIERS (one
+    perishable window must yield everything); a green CPU-backend probe
+    must NOT (no window to protect — the battery costs tens of minutes
+    there)."""
+    bench = _load_bench()
+    for knob in ("BENCH_FULL", "BENCH_LARGE", "BENCH_TIERS"):
+        monkeypatch.delenv(knob, raising=False)
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    for probe_info, expect_armed in (("tpu 1", True), ("cpu 8", False)):
+        captured = {}
+        monkeypatch.setattr(
+            bench, "_probe_accelerator", lambda t, i=probe_info: (True, i)
+        )
+
+        def fake_inner(env, t, captured=captured):
+            captured["armed"] = env.get("BENCH_FULL") == "1"
+            return {
+                "value": 1.0, "platform": "tpu", "num_rounds": 100,
+                "hist_precision": "highest",
+            }, None
+
+        monkeypatch.setattr(bench, "_run_inner", fake_inner)
+        assert bench.main() == 0
+        capsys.readouterr()
+        assert captured["armed"] == expect_armed, probe_info
+
+
+def test_main_armed_timeout_salvages_headline(tmp_path, monkeypatch, capsys):
+    """If the auto-armed battery overruns the inner timeout, main retries
+    once WITHOUT the extras so the window still yields the headline."""
+    bench = _load_bench()
+    for knob in ("BENCH_FULL", "BENCH_LARGE", "BENCH_TIERS"):
+        monkeypatch.delenv(knob, raising=False)
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    monkeypatch.setattr(
+        bench, "_probe_accelerator", lambda t: (True, "tpu 1")
+    )
+    runs = []
+
+    def flaky_inner(env, t):
+        runs.append(env.get("BENCH_FULL"))
+        if len(runs) == 1:
+            return None, "bench run timed out after 10s"
+        return {
+            "value": 2.0, "platform": "tpu", "num_rounds": 100,
+            "hist_precision": "highest",
+        }, None
+
+    monkeypatch.setattr(bench, "_run_inner", flaky_inner)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert runs == ["1", None]  # armed first, bare retry second
+    assert out["value"] == 2.0
+    assert "armed accelerator bench" in out.get("warnings", "")
+
+
 def test_flops_estimate_positive_and_monotone():
     bench = _load_bench()
     f1 = bench._flops_per_round(10_000, 16, 26, 5, 64)
