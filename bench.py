@@ -138,14 +138,20 @@ def main():
         # is not a window — no arming.  Explicit BENCH_*=0 still disables
         # a section.
         env = dict(os.environ)
-        armed = not info.startswith("cpu")
+        # the probe's platform is the LAST stdout line (plugin init may
+        # print noise first)
+        probed_platform = (info.splitlines() or [""])[-1]
+        armed = not probed_platform.startswith("cpu")
         if armed:
             for knob in ("BENCH_FULL", "BENCH_LARGE", "BENCH_TIERS"):
                 env.setdefault(knob, "1")
+        did_arm = env != dict(os.environ)
         result, err = _run_inner(env, inner_timeout)
-        if result is None and armed:
-            # the armed battery overran the timeout; the window may still
-            # be open — salvage the headline with a bare retry
+        if result is None and did_arm:
+            # the AUTO-armed battery overran the timeout; the window may
+            # still be open — salvage the headline with a bare retry.
+            # (If the user set the knobs themselves, a retry would rerun
+            # the identical config: skip it.)
             errors.append(f"armed accelerator bench: {err}")
             result, err = _run_inner(dict(os.environ), inner_timeout)
         if result is None:

@@ -1,0 +1,76 @@
+"""Dummy estimators: every strategy's constant equals the right dataset
+statistic, predictions are constant, and sample weights are honored —
+the reference's property suite
+(`DummyRegressorSuite.scala:54-109` "const is equal to right statistics",
+`DummyClassifierSuite.scala:54-79` "prediction is constant")."""
+
+import numpy as np
+
+import spark_ensemble_tpu as se
+
+
+def _data(seed=0, n=500):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 4).astype(np.float32)
+    y = (rng.randn(n) * 10 + 3).astype(np.float32)
+    return X, y
+
+
+def _weighted_crossing(y, w, q):
+    """The reference rule: first value (sorted) whose cumweight >= q*total."""
+    order = np.argsort(y)
+    cum = np.cumsum(w[order])
+    return float(y[order][np.searchsorted(cum, q * cum[-1], side="left")])
+
+
+def test_regressor_strategies_match_statistics():
+    X, y = _data()
+    for strategy, expect in (
+        ("mean", float(np.mean(y))),
+        ("median", _weighted_crossing(y, np.ones_like(y), 0.5)),
+        ("quantile", _weighted_crossing(y, np.ones_like(y), 0.25)),
+        ("constant", -7.5),
+    ):
+        m = se.DummyRegressor(
+            strategy=strategy, quantile=0.25, constant=-7.5
+        ).fit(X, y)
+        pred = np.asarray(m.predict(X))
+        assert np.all(pred == pred[0]), strategy  # constant prediction
+        np.testing.assert_allclose(pred[0], expect, rtol=1e-5, err_msg=strategy)
+
+
+def test_regressor_strategies_honor_sample_weight():
+    X, y = _data(1)
+    rng = np.random.RandomState(2)
+    w = rng.randint(0, 5, size=y.shape[0]).astype(np.float32)
+    m = se.DummyRegressor(strategy="mean").fit(X, y, sample_weight=w)
+    np.testing.assert_allclose(
+        float(np.asarray(m.predict(X[:1]))[0]),
+        float(np.average(y, weights=w)),
+        rtol=1e-5,
+    )
+    mq = se.DummyRegressor(strategy="quantile", quantile=0.8).fit(
+        X, y, sample_weight=w
+    )
+    assert float(np.asarray(mq.predict(X[:1]))[0]) == _weighted_crossing(
+        y, w, 0.8
+    )
+
+
+def test_classifier_strategies():
+    rng = np.random.RandomState(3)
+    X = rng.randn(400, 3).astype(np.float32)
+    y = rng.choice(3, size=400, p=[0.6, 0.3, 0.1]).astype(np.float32)
+    prior = se.DummyClassifier(strategy="prior").fit(X, y)
+    assert np.all(np.asarray(prior.predict(X)) == 0)  # majority class
+    np.testing.assert_allclose(
+        np.asarray(prior.predict_proba(X[:1]))[0],
+        np.bincount(y.astype(int), minlength=3) / 400.0,
+        atol=1e-6,
+    )
+    uni = se.DummyClassifier(strategy="uniform").fit(X, y)
+    np.testing.assert_allclose(
+        np.asarray(uni.predict_proba(X[:1]))[0], np.full(3, 1 / 3), atol=1e-6
+    )
+    const = se.DummyClassifier(strategy="constant", constant=2).fit(X, y)
+    assert np.all(np.asarray(const.predict(X)) == 2)
