@@ -45,6 +45,13 @@ _BLOCK_ROWS = 256
 _VMEM_BUDGET = 12 * 2**20
 
 
+# off-TPU, fit_forest only dispatches the interpreted kernel below this
+# many rows; larger inputs fall back to the 'high' matmul tier (the
+# Python-level interpreter is ~1e4x slower than compiled code and
+# effectively hangs at dataset scale)
+_INTERPRET_MAX_ROWS = 4096
+
+
 def _interpret() -> bool:
     """Interpreter mode off-TPU: correctness-only (tests use tiny shapes)."""
     try:

@@ -42,6 +42,7 @@ is a forest.
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import NamedTuple, Optional
 
 import jax
@@ -527,12 +528,28 @@ def fit_forest(
     pallas_tier = hist_precision.lower() == "pallas"
     if pallas_tier:
         from spark_ensemble_tpu.ops.pallas_hist import (
+            _INTERPRET_MAX_ROWS,
             _VMEM_BUDGET,
+            _interpret,
             hist_vmem_bytes,
         )
 
         hist = "matmul"  # the fused path below hosts the pallas kernel
-        if (
+        if _interpret() and n > _INTERPRET_MAX_ROWS:
+            # off-TPU the kernel only has the Python-level interpreter —
+            # fine at parity-test shapes, hangs at dataset scale.  Fall
+            # back to the 'high' matmul tier (the same statistic
+            # precision this tier uses for its other matmuls) instead of
+            # dispatching the interpreted kernel.
+            warnings.warn(
+                "hist_precision='pallas' requires a TPU backend at "
+                f"n={n} rows (interpreter mode is viable only below "
+                f"{_INTERPRET_MAX_ROWS}); falling back to the 'high' "
+                "matmul tier",
+                stacklevel=2,
+            )
+            pallas_tier = False
+        elif (
             hist_vmem_bytes(2 ** (max_depth - 1), M, 1 + k, d, B)
             > _VMEM_BUDGET
         ):

@@ -17,11 +17,20 @@ executor ever holds the full column — so the mesh path here must not
 ``all_gather`` the values either.  Instead it runs a fixed number of
 ``psum``-ed histogram-refinement rounds over the monotone u32 *bit* space of
 the f32 values: 4 rounds x 256 bins resolve one of the 2^32 possible keys
-exactly, so the result is the same "first value whose global cumulative
-weight reaches the target" the exact kernel computes — communicated state is
-O(bins) per round, never O(n).  (An f32-value-space bisection could need ~30+
-rounds to separate values across binades; bit-space refinement is exact in 4
-by construction.)  All kernels are jit/vmap-compatible (static shapes).
+exactly, so the result is the "first value whose global cumulative weight
+reaches the target" — communicated state is O(bins) per round, never O(n).
+(An f32-value-space bisection could need ~30+ rounds to separate values
+across binades; bit-space refinement is exact in 4 by construction.)
+
+Exactness caveat: the *key walk* is exact, but the crossing test compares
+f32 sums accumulated in different orders (the psum-ed per-bin cumulative vs
+the separately-summed total target), so with general f32 weights a
+crossing that lands within one ulp of the target can select the adjacent
+data value instead (`test_mesh_quantile_target_above_total_degrades_to_max`
+encodes the boundary case; the dyadic-weight tests sidestep it).  The
+result is always an actual data value, and bit-identical to the local sort
+kernel whenever the weight sums are exactly representable.  All kernels are
+jit/vmap-compatible (static shapes).
 """
 
 from __future__ import annotations

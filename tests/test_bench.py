@@ -83,7 +83,16 @@ def test_main_arms_full_battery_only_on_real_accelerator(
     for knob in ("BENCH_FULL", "BENCH_LARGE", "BENCH_TIERS"):
         monkeypatch.delenv(knob, raising=False)
     monkeypatch.setattr(bench, "_REPO", str(tmp_path))
-    for probe_info, expect_armed in (("tpu 1", True), ("cpu 8", False)):
+    for probe_info, expect_armed in (
+        ("tpu 1", True),
+        ("cpu 8", False),
+        # plugin init noise before the platform line must not confuse it
+        ("WARNING: Platform 'axon' is experimental\ntpu 1", True),
+        # unrecognized/empty probe output is NOT a window (advisor r4):
+        # arming the tens-of-minutes battery needs a recognized platform
+        ("", False),
+        ("something-unrecognized 3", False),
+    ):
         captured = {}
         monkeypatch.setattr(
             bench, "_probe_accelerator", lambda t, i=probe_info: (True, i)

@@ -123,6 +123,44 @@ def test_vmem_budget_falls_back_to_matmul(monkeypatch):
     assert np.isfinite(np.asarray(f.leaf_value)).all()
 
 
+def test_off_tpu_large_n_falls_back_to_high_tier(monkeypatch):
+    """Off-TPU above _INTERPRET_MAX_ROWS the tier must warn and take the
+    'high' matmul path instead of dispatching the (effectively hanging)
+    interpreted kernel (advisor r4).  Pinning the threshold low keeps the
+    test tiny while exercising the real guard."""
+    import warnings as _warnings
+
+    import spark_ensemble_tpu.ops.pallas_hist as ph
+
+    monkeypatch.setattr(ph, "_INTERPRET_MAX_ROWS", 100)
+    # force the off-TPU decision so the test is backend-independent
+    # (tree.py imports _interpret at call time, so the patch is seen)
+    monkeypatch.setattr(ph, "_interpret", lambda: True)
+    rng = np.random.RandomState(5)
+    # shapes distinct from every other test in this file: the guard (and
+    # its warning) runs at TRACE time, so a shape collision would reuse a
+    # cached program and skip it
+    n, d, M, k, B = 310, 5, 2, 1, 8
+    Xb, bins = _binned(rng, n, d, B)
+    Y = rng.randn(n, M, k).astype(np.float32)
+    w = np.ones((n, M), np.float32)
+    with _warnings.catch_warnings(record=True) as rec:
+        _warnings.simplefilter("always")
+        f = fit_forest(Xb, jnp.asarray(Y), jnp.asarray(w), bins.thresholds,
+                       hist_precision="pallas", max_depth=3, max_bins=B)
+    assert any("falling back to the 'high'" in str(r.message) for r in rec)
+    hi = fit_forest(Xb, jnp.asarray(Y), jnp.asarray(w), bins.thresholds,
+                    hist_precision="high", hist="matmul",
+                    max_depth=3, max_bins=B)
+    np.testing.assert_array_equal(
+        np.asarray(f.split_feature), np.asarray(hi.split_feature)
+    )
+    np.testing.assert_allclose(
+        np.asarray(f.leaf_value), np.asarray(hi.leaf_value),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
 def test_kernel_lowers_for_tpu(monkeypatch):
     """Cross-platform export: the REAL (non-interpret) kernel must lower
     through Mosaic for the TPU target at the benchmark shapes — the only
