@@ -142,3 +142,59 @@ def test_squared_loss_closed_form_matches_brent():
         den = float(np.sum(bw * direction * direction))
         a_closed = min(max(num / den, 0.0), 100.0)
         assert abs(a_brent - a_closed) < 1e-3, (trial, a_brent, a_closed)
+
+
+@pytest.mark.slow
+def test_warm_start_alpha_trajectory_matches_cold_start():
+    """The GBM line-search warm start (models/gbm.py round_core carries
+    alpha_ws across rounds) is a convergence-SPEED device only: on each
+    round's objective, a solve warm-started from the previous round's
+    converged alphas and a cold solve from all-ones must land on the same
+    step sizes within tol.  Emulates consecutive round_core line searches
+    exactly — same phi / closed-form grad_hess, same optimizer config
+    (max_iter 25, tol 1e-6) — over 5 drifting logloss rounds whose
+    directions approximate fitted-tree outputs (noisy negative gradients)."""
+    from spark_ensemble_tpu.ops.losses import LogLoss
+
+    rng = np.random.RandomState(7)
+    n, K, lr = 400, 4, 0.3
+    loss = LogLoss(K)
+    y = rng.randint(0, K, n).astype(np.float32)
+    y_enc = loss.encode_label(jnp.asarray(y))
+    bag_w = jnp.asarray(rng.poisson(1.0, n).astype(np.float32))
+    pred = jnp.zeros((n, K), jnp.float32)
+    alpha_ws = jnp.ones((K,), jnp.float32)
+    for rnd in range(5):
+        g = loss.gradient(y_enc, pred)
+        directions = -g + 0.05 * jnp.asarray(
+            rng.randn(n, K).astype(np.float32)
+        )
+
+        def phi(a, pred=pred, directions=directions):
+            return jnp.sum(
+                bag_w * loss.loss(y_enc, pred + a[None, :] * directions)
+            )
+
+        def gh(a, pred=pred, directions=directions):
+            return loss.linesearch_grad_hess(
+                y_enc, pred + a[None, :] * directions, directions, bag_w
+            )
+
+        warm = projected_newton_box(
+            phi, alpha_ws, max_iter=25, tol=1e-6, grad_hess=gh
+        )
+        if rnd > 0:  # round 0's warm start IS all-ones; nothing to compare
+            cold = projected_newton_box(
+                phi, jnp.ones((K,), jnp.float32), max_iter=25, tol=1e-6,
+                grad_hess=gh,
+            )
+            np.testing.assert_allclose(
+                np.asarray(warm), np.asarray(cold), rtol=2e-3, atol=5e-4,
+                err_msg=f"round {rnd}: warm/cold step sizes diverged",
+            )
+            # the objective values agree even tighter than the argmins
+            assert float(phi(warm)) == pytest.approx(
+                float(phi(cold)), rel=1e-5
+            )
+        alpha_ws = warm
+        pred = pred + lr * warm[None, :] * directions
