@@ -24,6 +24,8 @@ import pytest as _pytest
 pytestmark = _pytest.mark.slow
 
 
+import os
+
 import jax
 import numpy as np
 import pytest
@@ -622,3 +624,107 @@ def test_boosting_and_bagging_hybrid_mesh():
     bd = BaggingRegressor(**bcfg).fit(X, y, mesh=mesh)
     rb_s, rb_d = _rmse(bs.predict(X), y), _rmse(bd.predict(X), y)
     assert abs(rb_s - rb_d) < 0.03 * max(rb_s, rb_d) + 1e-6, (rb_s, rb_d)
+
+
+# --- communication contract -------------------------------------------------
+#
+# The distributed design's scalability claim, asserted mechanically: on a
+# pure data mesh, one GBM round communicates O(1) collectives carrying
+# O(nodes * bins * k) bytes — NEVER anything proportional to the row count
+# (the reference's treeAggregate contract, `GBMClassifier.scala:413-431`;
+# the gather-free quantile and histogram-psum design, ops/tree.py +
+# utils/quantile.py).  The REAL estimator programs are compiled in a
+# subprocess with --xla_dump_to and the optimized-HLO collectives compared
+# across two row counts: identical (op, shape) multisets == both the
+# collective COUNT and the communicated BYTES are independent of n.  The
+# test fails if anyone reintroduces a row-length all_gather.
+
+_CONTRACT_FIT = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import spark_ensemble_tpu as se
+from spark_ensemble_tpu.parallel.mesh import data_member_mesh
+
+n = {n}
+rng = np.random.RandomState(0)
+X = rng.randn(n, 8).astype(np.float32)
+yc = rng.randint(0, 4, n).astype(np.float32)
+yr = (X @ rng.randn(8).astype(np.float32) + rng.randn(n)).astype(np.float32)
+mesh = data_member_mesh(8, member=1)
+se.GBMClassifier(
+    num_base_learners=2, loss="logloss", updates="newton",
+    optimized_weights=True,
+).fit(X, yc, mesh=mesh)
+se.GBMRegressor(
+    num_base_learners=2, loss="huber",  # huber: mesh quantile path
+).fit(X, yr, mesh=mesh)
+print("contract fit ok")
+"""
+
+
+def _collect_collectives(dump_dir):
+    """Multiset of (op, normalized shape) over every optimized-HLO module,
+    plus the largest dimension seen in any collective shape."""
+    import collections
+    import glob
+    import re
+
+    ops = collections.Counter()
+    max_dim = 0
+    pat = re.compile(
+        r"= (\([^)]*\)|\S+) (all-reduce|all-gather|all-to-all|"
+        r"reduce-scatter|collective-permute)\("
+    )
+    for path in glob.glob(os.path.join(dump_dir, "*after_optimizations.txt")):
+        with open(path) as f:
+            for line in f:
+                m = pat.search(line)
+                if not m:
+                    continue
+                shape = re.sub(r"\{[^}]*\}", "", m.group(1))  # drop layouts
+                ops[(m.group(2), shape)] += 1
+                for dim in re.findall(r"\d+", shape):
+                    max_dim = max(max_dim, int(dim))
+    return ops, max_dim
+
+
+def test_mesh_round_collectives_independent_of_n(tmp_path):
+    """See the section comment: (a) the collective inventory of the whole
+    compiled fit is IDENTICAL at n=1024 and n=4096, (b) no collective
+    operand carries a row-sized dimension at either n."""
+    import subprocess
+    import sys
+
+    inventories = {}
+    for n in (1024, 4096):
+        dump = tmp_path / f"dump_{n}"
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            "--xla_force_host_platform_device_count=8 "
+            f"--xla_dump_to={dump} --xla_dump_hlo_pass_re=NONE"
+        )
+        p = subprocess.run(
+            [sys.executable, "-c", _CONTRACT_FIT.format(n=n)],
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        assert p.returncode == 0, p.stderr[-3000:]
+        ops, max_dim = _collect_collectives(str(dump))
+        assert ops, "no collectives found — dump layout changed?"
+        # (b) nothing row-sized crosses the mesh.  The absolute guard only
+        # bites at the larger n (the 256-bin quantile histograms are a
+        # FIXED width that exceeds the small run's 128-row shards); any
+        # row-proportional operand would also break the equality below.
+        if n // 8 > 256:
+            assert max_dim < n // 8, (
+                f"collective operand carries a row-sized dim at n={n}: "
+                f"max {max_dim}"
+            )
+        inventories[n] = ops
+    # (a) count AND shapes identical across a 4x row-count change
+    assert inventories[1024] == inventories[4096], (
+        "collective inventory depends on n:\n"
+        f"only@1024: {inventories[1024] - inventories[4096]}\n"
+        f"only@4096: {inventories[4096] - inventories[1024]}"
+    )
