@@ -149,7 +149,9 @@ def main():
         probed_platform = (info.splitlines() or [""])[-1].split(" ")[0]
         armed = probed_platform in ("tpu", "gpu", "cuda", "rocm")
         if armed:
-            for knob in ("BENCH_FULL", "BENCH_LARGE", "BENCH_TIERS"):
+            for knob in (
+                "BENCH_FULL", "BENCH_LARGE", "BENCH_TIERS", "BENCH_XL"
+            ):
                 env.setdefault(knob, "1")
         did_arm = env != dict(os.environ)
         result, err = _run_inner(env, inner_timeout)
@@ -427,6 +429,59 @@ def _bench_large_extras():
         return {"large_error": str(e)[:200]}
 
 
+def _bench_xl_extras():
+    """BENCH_XL=1: HBM-relevant scale — n=2,097,152 x d=64, k=8, 64 bins,
+    hist='stream' (the row-chunked tier, ops/tree.py _fit_forest_streamed;
+    the dense path's bin-one-hot operand alone would be ~16 GB here).  On
+    CPU the row count drops (BENCH_XL_ROWS, default 262144) so the same
+    tier program still executes end-to-end; the full-scale number rides a
+    TPU window.  Extra JSON fields; failures recorded, not fatal."""
+    import numpy as np
+
+    import jax
+
+    from spark_ensemble_tpu import DecisionTreeRegressor, GBMClassifier
+
+    try:
+        platform = jax.devices()[0].platform
+        n = _env_int(
+            "BENCH_XL_ROWS", 2_097_152 if platform != "cpu" else 262_144
+        )
+        d, k = 64, 8
+        rng = np.random.RandomState(0)
+        X = rng.randn(n, d).astype(np.float32)
+        centers = rng.randn(k, d).astype(np.float32)
+        y = np.argmax(
+            X @ centers.T + 0.5 * rng.randn(n, k), axis=1
+        ).astype(np.float32)
+        rounds = _env_int(
+            "BENCH_XL_ROUNDS", 10 if platform != "cpu" else 3
+        )
+        est = GBMClassifier(
+            num_base_learners=rounds, loss="logloss", updates="newton",
+            learning_rate=0.3,
+            base_learner=DecisionTreeRegressor(hist="stream"),
+        )
+        # warmup at the SAME round count (see _bench_large_extras)
+        est.fit(X, y)
+        model, fit_s = _timed_fit(est, X, y)
+        flops = _flops_per_round(n, d, k, 5, 64)
+        out = {
+            "xl_iters_per_sec": round(rounds / fit_s, 3),
+            "xl_fit_seconds": round(fit_s, 2),
+            "xl_config": (
+                f"synthetic n={n} d={d} k={k} rounds={rounds} hist=stream"
+            ),
+        }
+        if platform != "cpu":
+            out["xl_mfu_est"] = round(
+                flops * (rounds / fit_s) / _peak_flops(platform), 5
+            )
+        return out
+    except Exception as e:  # noqa: BLE001 - carry the error, keep going
+        return {"xl_error": str(e)[:200]}
+
+
 def _block_on_model(model):
     """Block on EVERY jax array reachable from the fitted model — composite
     models (stacking, pipelines) keep their arrays in base_models /
@@ -508,6 +563,8 @@ def inner():
         extras = _bench_full_extras()
     if os.environ.get("BENCH_LARGE") == "1":
         extras.update(_bench_large_extras())
+    if os.environ.get("BENCH_XL") == "1":
+        extras.update(_bench_xl_extras())
     if os.environ.get("BENCH_TIERS") == "1":
         # one run captures the whole hist_precision comparison (a TPU
         # window is perishable; see BASELINE.md): re-fit at the OTHER

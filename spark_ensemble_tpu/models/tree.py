@@ -51,6 +51,17 @@ class _TreeLearner(BaseLearner):
         "backends — elsewhere it runs interpreted, tests only).  Routing "
         "stays exact on every setting.",
     )
+    hist = Param(
+        "auto",
+        in_array(["auto", "scatter", "matmul", "stream"]),
+        doc="Histogram accumulation backend (ops/tree.py): 'auto' picks "
+        "the one-hot matmul on accelerators (MXU path), segment_sum "
+        "scatter-adds on CPU, and the row-chunked 'stream' tier when the "
+        "matmul's [n, d*bins] one-hot outgrows its budget; 'stream' "
+        "forces the chunked tier — the HBM-scale path (>~1M rows) whose "
+        "per-level traffic is one read of the compact binned features "
+        "instead of materialized full-n one-hots.",
+    )
     seed = Param(0)
 
     def make_fit_ctx(self, X, num_classes=None):
@@ -73,6 +84,7 @@ class _TreeLearner(BaseLearner):
             max_bins=self.max_bins,
             min_info_gain=self.min_info_gain,
             axis_name=axis_name,
+            hist=self.hist,
             hist_precision=self.hist_precision,
         )
 
@@ -94,6 +106,7 @@ class _TreeLearner(BaseLearner):
             max_bins=self.max_bins,
             min_info_gain=self.min_info_gain,
             axis_name=axis_name,
+            hist=self.hist,
             hist_precision=self.hist_precision,
         )
 

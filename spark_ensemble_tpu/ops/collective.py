@@ -26,6 +26,18 @@ def pmax_reduce(x, axis_name: Optional[str]):
     return jax.lax.pmax(x, axis_name) if axis_name is not None else x
 
 
+def pvary_like_shard(x, axis_name: Optional[str]):
+    """Mark ``x`` as varying over ``axis_name`` for shard_map's manual-axes
+    tracking; identity when unsharded.  Needed for replicated literals
+    (e.g. a ``lax.scan`` zero accumulator) that combine with sharded
+    operands inside the scan body — without it the carry's in/out types
+    disagree on their varying axes."""
+    if axis_name is None:
+        return x
+    names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+    return jax.lax.pcast(x, names, to="varying")
+
+
 def pmin_reduce(x, axis_name: Optional[str]):
     """``pmin`` over ``axis_name`` inside shard_map; identity when unsharded
     (brackets the distributed quantile refinement, `utils/quantile.py`)."""

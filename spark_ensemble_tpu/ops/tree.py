@@ -48,7 +48,10 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from spark_ensemble_tpu.ops.collective import preduce as _preduce
+from spark_ensemble_tpu.ops.collective import (
+    preduce as _preduce,
+    pvary_like_shard as _pvary_like_shard,
+)
 
 
 class Tree(NamedTuple):
@@ -155,6 +158,85 @@ def _prefix_sums(hist_w, hist_wy, bins_axis_w, stat_prec, hist):
     return cw, cwy
 
 
+def _bin_one_hot(Xb, B):
+    """Row-to-bin one-hot ``f32[rows, d*B]`` — the histogram matmul's RHS,
+    shared by every tier that builds it (fit_tree, dense fit_forest, and
+    the stream tier's per-chunk body)."""
+    rows, d = Xb.shape
+    return (
+        (Xb[:, :, None] == jnp.arange(B, dtype=Xb.dtype))
+        .astype(jnp.float32)
+        .reshape(rows, d * B)
+    )
+
+
+def _route_members(Xb, node, best_f, best_t, n_nodes, route_prec):
+    """Gather-free level routing shared by the dense and streamed fused-
+    forest paths (see fit_tree): contract the node one-hot against the
+    split tables — each contraction picks exactly one small-int term, so
+    single-pass bf16 is bit-exact for max_bins <= 256
+    (`_routing_precision`).  ``Xb [n, d]``, ``node [n, M]`` level-local
+    ids -> child-level ids."""
+    d = Xb.shape[1]
+    node_oh = jax.nn.one_hot(node, n_nodes, dtype=jnp.float32)  # [n,M,nodes]
+    t_row = jnp.einsum(
+        "nmo,mo->nm", node_oh, best_t.astype(jnp.float32),
+        precision=route_prec,
+    )
+    f_oh = jax.nn.one_hot(best_f, d, dtype=jnp.float32)  # [M, nodes, d]
+    sel = jnp.einsum("nmo,mod->nmd", node_oh, f_oh, precision=route_prec)
+    xb_f = jnp.einsum(
+        "nmd,nd->nm", sel, Xb.astype(jnp.float32), precision=route_prec
+    )
+    return 2 * node + jnp.where(xb_f <= t_row, 0, 1)
+
+
+def _level_split_tables(
+    H, feature_mask, node_floor, min_info_gain, thresholds, B, stat_prec,
+    hist,
+):
+    """Candidate-split scoring for one level, shared by the dense and
+    streamed fused-forest paths: histograms ``H [M, nodes, 1+k, d, B]`` ->
+    best-split tables + per-node statistics.  Same gain rule and
+    tie-breaking argmax as ``fit_tree``."""
+    M, n_nodes, _, d, _ = H.shape
+    hist_w = H[:, :, 0]  # [M, nodes, d, B]
+    hist_wy = jnp.moveaxis(H[:, :, 1:], 2, -1)  # [M,nodes,d,B,k]
+
+    cw, cwy = _prefix_sums(hist_w, hist_wy, 3, stat_prec, hist)
+    W = cw[:, :, :1, -1:]  # [M, nodes, 1, 1]
+    S = cwy[:, :, :1, -1:, :]  # [M, nodes, 1, 1, k]
+    WL = cw[:, :, :, : B - 1]
+    SL = cwy[:, :, :, : B - 1, :]
+    WR = W - WL
+    SR = S - SL
+
+    def score(s, wgt):
+        return jnp.sum(s * s, axis=-1) / jnp.maximum(wgt, 1e-12)
+
+    parent_score = score(S[:, :, 0, 0, :], W[:, :, 0, 0])[:, :, None, None]
+    gain = score(SL, WL) + score(SR, WR) - parent_score  # [M,nodes,d,B-1]
+    wf = node_floor[:, :, None, None]
+    valid = (WL > wf) & (WR > wf) & feature_mask[:, None, :, None]
+    gain = jnp.where(valid, gain, -jnp.inf)
+
+    flat = gain.reshape(M, n_nodes, d * (B - 1))
+    best = jnp.argmax(flat, axis=2)
+    best_gain = jnp.take_along_axis(flat, best[:, :, None], axis=2)[:, :, 0]
+    best_f = (best // (B - 1)).astype(jnp.int32)
+    best_t = (best % (B - 1)).astype(jnp.int32)
+
+    do_split = best_gain > min_info_gain
+    best_f = jnp.where(do_split, best_f, 0)
+    best_t = jnp.where(do_split, best_t, B - 1)
+    thr = jnp.where(
+        do_split, thresholds[best_f, jnp.minimum(best_t, B - 2)], jnp.inf
+    )
+    node_w = cw[:, :, 0, -1]  # [M, nodes]
+    node_wy = cwy[:, :, 0, -1, :]  # [M, nodes, k]
+    return best_f, best_t, thr, do_split, best_gain, node_w, node_wy
+
+
 def _stat_precision_vs_onehot(stat_prec):
     """Per-operand precision for statistic matmuls whose OTHER side is a
     pure 0/1 one-hot: the one-hot is exactly bf16-representable, so it
@@ -168,9 +250,14 @@ def _resolve_hist(hist: str, n: int, d: int, B: int) -> str:
     if hist != "auto":
         return hist
     # every accelerator backend (tpu, tpu-like plugins, gpu) serializes
-    # scatter-adds; only CPU prefers the segment_sum path
-    if jax.default_backend() != "cpu" and n * d * B <= _MATMUL_HIST_MAX_CELLS:
-        return "matmul"
+    # scatter-adds; only CPU prefers the segment_sum path.  Past the
+    # matmul tier's one-hot budget an accelerator takes the row-chunked
+    # STREAM tier (same matmuls, no [n, d*B] operand) instead of the
+    # serializing scatter path.
+    if jax.default_backend() != "cpu":
+        if n * d * B <= _MATMUL_HIST_MAX_CELLS:
+            return "matmul"
+        return "stream"
     return "scatter"
 
 
@@ -192,7 +279,7 @@ def fit_tree(
     max_bins: int = 64,
     min_info_gain: float = 0.0,
     axis_name: Optional[str] = None,
-    hist: str = "auto",  # auto | scatter | matmul
+    hist: str = "auto",  # auto | scatter | matmul | stream
     hist_precision: str = "highest",  # statistic-matmul MXU passes, see below
 ) -> Tree:
     """``hist_precision`` sets the MXU precision of the STATISTIC math
@@ -213,6 +300,23 @@ def fit_tree(
     B = max_bins
     num_internal = 2**max_depth - 1
     hist = _resolve_hist(hist, n, d, B)
+    if hist == "stream":
+        # the row-chunked tier lives in the fused-forest path; a single
+        # tree is its M=1 case
+        forest = fit_forest(
+            Xb,
+            Y[:, None, :],
+            w[:, None],
+            thresholds,
+            feature_mask,
+            max_depth=max_depth,
+            max_bins=max_bins,
+            min_info_gain=min_info_gain,
+            axis_name=axis_name,
+            hist="stream",
+            hist_precision=hist_precision,
+        )
+        return jax.tree_util.tree_map(lambda a: a[0], forest)
     # case-normalized here (not at the Param) so direct kernel callers get
     # the same tolerance as estimator users
     stat_prec = _HIST_PRECISION[hist_precision.lower()]
@@ -233,11 +337,7 @@ def fit_tree(
     feat_offsets = jnp.arange(d, dtype=jnp.int32) * B
     if hist == "matmul":
         # loop-invariant row-to-bin one-hot, consumed by every level's matmul
-        bin_oh = (
-            (Xb[:, :, None] == jnp.arange(B, dtype=Xb.dtype))
-            .astype(jnp.float32)
-            .reshape(n, d * B)
-        )
+        bin_oh = _bin_one_hot(Xb, B)
 
     split_feature = jnp.zeros((num_internal,), jnp.int32)
     split_bin = jnp.zeros((num_internal,), jnp.int32)
@@ -478,6 +578,159 @@ def feature_gains(trees: Tree, d: int) -> jax.Array:
 # the vmapped per-tree path is used instead
 _FOREST_FUSED_MAX_CELLS = 2**28
 
+# rows per scan step of the STREAM tier: bounds the chunk's one-hot
+# intermediates (bin_oh [chunk, d*B], A [chunk, M*nodes*(1+k)]) while
+# keeping the matmul's contraction dim MXU-sized
+_STREAM_CHUNK_ROWS = 32768
+
+
+def _fit_forest_streamed(
+    Xb, Y, w, thresholds, feature_mask, *, max_depth, max_bins,
+    min_info_gain, axis_name, stat_prec, route_prec,
+):
+    """Row-chunked fused-forest fit (``hist="stream"``): the HBM-scale tier.
+
+    The dense matmul path materializes three [n, ...] one-hot operands per
+    level (``bin_oh [n, d*B]``, ``A [n, M*nodes*(1+k)]``, ``node_oh``) —
+    ~16 GB of bin-one-hot alone at n=2M, d=64, B=64.  Here each level is ONE
+    ``lax.scan`` over row chunks whose body (a) routes the chunk through the
+    PREVIOUS level's split tables and (b) builds the chunk's one-hots in
+    registers/VMEM and matmul-accumulates this level's histogram — so the
+    per-level HBM traffic is one read of the compact inputs (binned
+    features, node ids, value channels) and the one-hots never exist at full
+    n.  Same statistic precision, gain rule, tie-breaking argmax, and psum
+    points as the dense path (histograms are psum-ed AFTER the scan, so the
+    mesh contract stays O(nodes·bins·k) per level; the reference's
+    treeAggregate analogue, `GBMClassifier.scala:413-431`).  Prefix sums
+    run as exact cumsums (`_prefix_sums` keys its tri-matmul fast path on
+    the dense tier).
+
+    Routing identity: level-L routing is deferred into the level-(L+1)
+    scan body (and the leaf scan) — the same einsum contractions at the
+    same precision as the dense path, just chunked.
+    """
+    n, d = Xb.shape
+    _, M, k = Y.shape
+    B = max_bins
+    C = 1 + k
+    num_internal = 2**max_depth - 1
+    preduce = lambda x: _preduce(x, axis_name)
+    _pvary = lambda x: _pvary_like_shard(x, axis_name)
+
+    w = w.astype(jnp.float32)
+    w_tot = preduce(jnp.sum(w, axis=0))  # [M]
+    y_mean = preduce(jnp.sum(w[:, :, None] * Y, axis=0)) / jnp.maximum(
+        w_tot[:, None], 1e-30
+    )  # [M, k]
+    vals = jnp.concatenate(
+        [w[:, :, None], w[:, :, None] * (Y - y_mean[None, :, :])], axis=2
+    )  # [n, M, 1+k]
+
+    chunk = min(_STREAM_CHUNK_ROWS, n)
+    nc = -(-n // chunk)
+    pad = nc * chunk - n
+    # zero-weight padding: all-zero ``vals`` rows contribute exactly 0.0
+    # to every histogram/leaf statistic; where they route is irrelevant
+    Xb_c = jnp.pad(Xb, ((0, pad), (0, 0))).reshape(nc, chunk, d)
+    vals_c = jnp.pad(vals, ((0, pad), (0, 0), (0, 0))).reshape(
+        nc, chunk, M, C
+    )
+    node_c = jnp.zeros((nc, chunk, M), jnp.int32)
+
+    split_feature = jnp.zeros((M, num_internal), jnp.int32)
+    split_bin = jnp.zeros((M, num_internal), jnp.int32)
+    split_threshold = jnp.zeros((M, num_internal), jnp.float32)
+    split_gain = jnp.zeros((M, num_internal), jnp.float32)
+    parent_value = y_mean[:, None, :]  # [M, 1, k]
+    prev_tables = None  # (best_f, best_t) of the previous level
+
+    for level in range(max_depth):
+        n_nodes = 2**level
+
+        def body(acc, xs, n_nodes=n_nodes, tables=prev_tables):
+            xb, nd, vl = xs
+            if tables is not None:
+                nd = _route_members(
+                    xb, nd, tables[0], tables[1], n_nodes // 2, route_prec
+                )
+            node_oh = jax.nn.one_hot(nd, n_nodes, dtype=jnp.float32)
+            bin_oh = _bin_one_hot(xb, B)
+            A = (node_oh[:, :, :, None] * vl[:, :, None, :]).reshape(
+                chunk, M * n_nodes * C
+            )
+            acc = acc + jax.lax.dot_general(
+                A.T,
+                bin_oh,
+                (((1,), (0,)), ((), ())),
+                precision=_stat_precision_vs_onehot(stat_prec),
+            ).reshape(M, n_nodes, C, d, B)
+            return acc, nd
+
+        H, node_c = jax.lax.scan(
+            body,
+            _pvary(jnp.zeros((M, n_nodes, C, d, B), jnp.float32)),
+            (Xb_c, node_c, vals_c),
+        )
+        H = preduce(H)
+
+        node_floor = jnp.full((M, n_nodes), 1e-12, jnp.float32)
+        best_f, best_t, thr, do_split, best_gain, node_w, node_wy = (
+            _level_split_tables(
+                H, feature_mask, node_floor, min_info_gain, thresholds, B,
+                stat_prec, "stream",
+            )
+        )
+
+        heap = (2**level - 1) + jnp.arange(n_nodes)
+        split_feature = split_feature.at[:, heap].set(best_f)
+        split_bin = split_bin.at[:, heap].set(best_t)
+        split_threshold = split_threshold.at[:, heap].set(thr)
+        split_gain = split_gain.at[:, heap].set(
+            jnp.where(do_split, best_gain, 0.0)
+        )
+
+        node_val = node_wy / jnp.maximum(node_w[:, :, None], 1e-30)
+        node_val = jnp.where(
+            node_w[:, :, None] > node_floor[:, :, None], node_val,
+            parent_value,
+        )
+        parent_value = jnp.repeat(node_val, 2, axis=1)
+        prev_tables = (best_f, best_t)
+
+    # final scan: route the last level, accumulate leaf sums
+    num_leaves = 2**max_depth
+
+    def leaf_body(acc, xs, tables=prev_tables):
+        xb, nd, vl = xs
+        nd = _route_members(
+            xb, nd, tables[0], tables[1], num_leaves // 2, route_prec
+        )
+        leaf_oh = jax.nn.one_hot(nd, num_leaves, dtype=jnp.float32)
+        acc = acc + jnp.einsum(
+            "nml,nmc->mlc", leaf_oh, vl,
+            precision=_stat_precision_vs_onehot(stat_prec)[::-1],
+        )
+        return acc, None
+
+    L, _ = jax.lax.scan(
+        leaf_body,
+        _pvary(jnp.zeros((M, num_leaves, C), jnp.float32)),
+        (Xb_c, node_c, vals_c),
+    )
+    leaf_w = preduce(L[:, :, 0])  # [M, L]
+    leaf_wy = preduce(L[:, :, 1:])  # [M, L, k]
+    leaf_value = leaf_wy / jnp.maximum(leaf_w[:, :, None], 1e-30)
+    leaf_value = jnp.where(
+        leaf_w[:, :, None] > 1e-12, leaf_value, parent_value
+    )
+    return Tree(
+        split_feature=split_feature,
+        split_bin=split_bin,
+        split_threshold=split_threshold,
+        leaf_value=leaf_value + y_mean[:, None, :],
+        split_gain=split_gain,
+    )
+
 
 @functools.partial(
     jax.jit,
@@ -525,7 +778,20 @@ def fit_forest(
     # kernel (ops/pallas_hist.py) — no bin_oh / A-matrix HBM operands.
     # Falls back to the 'high' matmul tier when the accumulator would not
     # fit the kernel's VMEM budget (static shapes, decided here).
+    # The kernel is hosted by the FUSED MATMUL path only, and the stream
+    # tier wins any conflict: an explicit hist='stream' (or an 'auto'
+    # resolution past the matmul one-hot budget) takes the chunked path —
+    # which exists precisely for shapes whose dense one-hot operands (the
+    # pallas fallback path) cannot materialize — at the same 'high'
+    # statistic precision the pallas tier maps to.
     pallas_tier = hist_precision.lower() == "pallas"
+    if pallas_tier and hist == "auto":
+        hist = (
+            "matmul" if n * d * B <= _MATMUL_HIST_MAX_CELLS else "stream"
+        )
+    elif not (pallas_tier and hist == "matmul"):
+        hist = _resolve_hist(hist, n, d, B)
+    pallas_tier = pallas_tier and hist == "matmul"
     if pallas_tier:
         from spark_ensemble_tpu.ops.pallas_hist import (
             _INTERPRET_MAX_ROWS,
@@ -534,7 +800,6 @@ def fit_forest(
             hist_vmem_bytes,
         )
 
-        hist = "matmul"  # the fused path below hosts the pallas kernel
         if _interpret() and n > _INTERPRET_MAX_ROWS:
             # off-TPU the kernel only has the Python-level interpreter —
             # fine at parity-test shapes, hangs at dataset scale.  Fall
@@ -554,8 +819,6 @@ def fit_forest(
             > _VMEM_BUDGET
         ):
             pallas_tier = False
-    else:
-        hist = _resolve_hist(hist, n, d, B)
     # case-normalized here (not at the Param) so direct kernel callers get
     # the same tolerance as estimator users
     stat_prec = _HIST_PRECISION[hist_precision.lower()]
@@ -565,6 +828,16 @@ def fit_forest(
         feature_mask = jnp.ones((M, d), bool)
     elif feature_mask.ndim == 1:
         feature_mask = jnp.broadcast_to(feature_mask[None, :], (M, d))
+
+    if hist == "stream":
+        # row-chunked tier: no full-n one-hot intermediates, so neither
+        # the matmul budget below nor the per-tree fallback applies
+        return _fit_forest_streamed(
+            Xb, Y, w, thresholds, feature_mask,
+            max_depth=max_depth, max_bins=max_bins,
+            min_info_gain=min_info_gain, axis_name=axis_name,
+            stat_prec=stat_prec, route_prec=route_prec,
+        )
 
     # budget the fused path by its LARGEST [n, M, ...] intermediate: the
     # A-matrix build for the matmul tiers; only the routing one-hot
@@ -604,11 +877,7 @@ def fit_forest(
     if not pallas_tier:
         # loop-invariant row-to-bin one-hot; the pallas tier builds it
         # per block in VMEM instead of materializing [n, d*B] in HBM
-        bin_oh = (
-            (Xb[:, :, None] == jnp.arange(B, dtype=Xb.dtype))
-            .astype(jnp.float32)
-            .reshape(n, d * B)
-        )
+        bin_oh = _bin_one_hot(Xb, B)
 
     split_feature = jnp.zeros((M, num_internal), jnp.int32)
     split_bin = jnp.zeros((M, num_internal), jnp.int32)
@@ -628,7 +897,6 @@ def fit_forest(
     for level in range(max_depth):
         n_nodes = 2**level
         # ---- ONE histogram matmul for every member ------------------------
-        node_oh = jax.nn.one_hot(node, n_nodes, dtype=jnp.float32)  # [n,M,nodes]
         if fast_tier and level >= 1:
             # histogram-subtraction trick (see fit_tree): left children
             # only, right = parent - left; halves the matmul's M dim
@@ -656,6 +924,9 @@ def fit_forest(
                 hist_level_pallas(Xb, node, vals, n_nodes=n_nodes, max_bins=B)
             )
         else:
+            node_oh = jax.nn.one_hot(
+                node, n_nodes, dtype=jnp.float32
+            )  # [n, M, nodes]
             A = (node_oh[:, :, :, None] * vals[:, :, None, :]).reshape(
                 n, M * n_nodes * (1 + k)
             )
@@ -668,23 +939,8 @@ def fit_forest(
                 ).reshape(M, n_nodes, 1 + k, d, B)
             )
         prev_H = H
-        hist_w = H[:, :, 0]  # [M, nodes, d, B]
-        hist_wy = jnp.moveaxis(H[:, :, 1:], 2, -1)  # [M,nodes,d,B,k]
 
         # ---- candidate split scores (same rule as fit_tree) ---------------
-        cw, cwy = _prefix_sums(hist_w, hist_wy, 3, stat_prec, hist)
-        W = cw[:, :, :1, -1:]  # [M, nodes, 1, 1]
-        S = cwy[:, :, :1, -1:, :]  # [M, nodes, 1, 1, k]
-        WL = cw[:, :, :, : B - 1]
-        SL = cwy[:, :, :, : B - 1, :]
-        WR = W - WL
-        SR = S - SL
-
-        def score(s, wgt):
-            return jnp.sum(s * s, axis=-1) / jnp.maximum(wgt, 1e-12)
-
-        parent_score = score(S[:, :, 0, 0, :], W[:, :, 0, 0])[:, :, None, None]
-        gain = score(SL, WL) + score(SR, WR) - parent_score  # [M,nodes,d,B-1]
         if fast_tier and level >= 1:
             # per-child accumulated floors: direct LEFT children reset to
             # the direct-path floor, derived RIGHT children accumulate
@@ -697,21 +953,11 @@ def fit_forest(
             ).reshape(M, n_nodes)
         else:
             node_floor = jnp.full((M, n_nodes), 1e-12, jnp.float32)
-        wf = node_floor[:, :, None, None]
-        valid = (WL > wf) & (WR > wf) & feature_mask[:, None, :, None]
-        gain = jnp.where(valid, gain, -jnp.inf)
-
-        flat = gain.reshape(M, n_nodes, d * (B - 1))
-        best = jnp.argmax(flat, axis=2)
-        best_gain = jnp.take_along_axis(flat, best[:, :, None], axis=2)[:, :, 0]
-        best_f = (best // (B - 1)).astype(jnp.int32)
-        best_t = (best % (B - 1)).astype(jnp.int32)
-
-        do_split = best_gain > min_info_gain
-        best_f = jnp.where(do_split, best_f, 0)
-        best_t = jnp.where(do_split, best_t, B - 1)
-        thr = jnp.where(
-            do_split, thresholds[best_f, jnp.minimum(best_t, B - 2)], jnp.inf
+        best_f, best_t, thr, do_split, best_gain, node_w, node_wy = (
+            _level_split_tables(
+                H, feature_mask, node_floor, min_info_gain, thresholds, B,
+                stat_prec, hist,
+            )
         )
 
         heap = (2**level - 1) + jnp.arange(n_nodes)
@@ -723,30 +969,9 @@ def fit_forest(
         )
 
         # ---- route rows to children (all members at once) -----------------
-        # gather-free (see fit_tree): contract the node one-hot against the
-        # split tables; each contraction picks exactly one small-int term ->
-        # single-pass bf16 is bit-exact for max_bins <= 256
-        t_row = jnp.einsum(
-            "nmo,mo->nm",
-            node_oh,
-            best_t.astype(jnp.float32),
-            precision=route_prec,
-        )
-        f_oh = jax.nn.one_hot(best_f, d, dtype=jnp.float32)  # [M, nodes, d]
-        sel = jnp.einsum(
-            "nmo,mod->nmd", node_oh, f_oh, precision=route_prec
-        )
-        xb_f = jnp.einsum(
-            "nmd,nd->nm",
-            sel,
-            Xb.astype(jnp.float32),
-            precision=route_prec,
-        )
-        go_left = xb_f <= t_row
-        node = 2 * node + jnp.where(go_left, 0, 1)
+        node = _route_members(Xb, node, best_f, best_t, n_nodes, route_prec)
 
-        node_w = cw[:, :, 0, -1]  # [M, nodes]
-        node_val = cwy[:, :, 0, -1, :] / jnp.maximum(node_w[:, :, None], 1e-30)
+        node_val = node_wy / jnp.maximum(node_w[:, :, None], 1e-30)
         # tier-scaled floor also guards the fallback value (see fit_tree)
         node_val = jnp.where(
             node_w[:, :, None] > node_floor[:, :, None], node_val, parent_value

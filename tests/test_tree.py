@@ -745,3 +745,177 @@ def test_subtraction_path_empty_children_record_no_spurious_splits():
         np.testing.assert_allclose(gains[1:], 0.0, atol=1e-6)
         feats = np.asarray(t.split_feature)
         assert (feats[1:] == 0).all()  # sentinel feature 0, no real splits
+
+
+# --- stream tier (row-chunked fused forest; the HBM-scale path) ------------
+
+
+def test_stream_tier_matches_matmul(monkeypatch):
+    """hist='stream' == the dense matmul tier: identical splits, close
+    leaves — across multiple chunks WITH padding (n=1000 at chunk=128)."""
+    import spark_ensemble_tpu.ops.tree as T
+
+    monkeypatch.setattr(T, "_STREAM_CHUNK_ROWS", 128)
+    rng = np.random.RandomState(21)
+    n, d, M, k, B = 1000, 6, 3, 2, 16
+    X = jnp.asarray(rng.randn(n, d).astype(np.float32))
+    b = compute_bins(X, B)
+    Xb = bin_features(X, b)
+    Y = jnp.asarray((rng.randint(-16, 17, size=(n, M, k)) / 8.0).astype(np.float32))
+    w = jnp.asarray((rng.randint(0, 3, size=(n, M)) / 2.0).astype(np.float32))
+    kw = dict(max_depth=4, max_bins=B)
+    dense = T.fit_forest(
+        Xb, Y, w, b.thresholds, hist="matmul", **kw
+    )
+    stream = T.fit_forest(
+        Xb, Y, w, b.thresholds, hist="stream", **kw
+    )
+    np.testing.assert_array_equal(
+        np.asarray(dense.split_feature), np.asarray(stream.split_feature)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(dense.split_bin), np.asarray(stream.split_bin)
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense.leaf_value), np.asarray(stream.leaf_value),
+        rtol=1e-4, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense.split_threshold),
+        np.asarray(stream.split_threshold), rtol=1e-5,
+    )
+
+
+def test_stream_tier_sharded_matches_single_device(monkeypatch):
+    """Stream tier under shard_map row sharding: the per-level histogram
+    psum happens AFTER the chunk scan, so the mesh result matches the
+    single-device stream fit (and the collective stays O(nodes·bins·k))."""
+    import functools
+
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import spark_ensemble_tpu.ops.tree as T
+
+    monkeypatch.setattr(T, "_STREAM_CHUNK_ROWS", 64)
+    rng = np.random.RandomState(22)
+    n, d, M = 1024, 4, 3
+    X = jnp.asarray(rng.randn(n, d).astype(np.float32))
+    b = compute_bins(X, 16)
+    Xb = bin_features(X, b)
+    Y = jnp.asarray(rng.randn(n, M, 1).astype(np.float32))
+    w = jnp.ones((n, M))
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    kw = dict(max_depth=3, max_bins=16, hist="stream")
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P("data", None), P("data", None, None), P("data", None)),
+        out_specs=P(),
+    )
+    def sharded(Xb_s, Y_s, w_s):
+        return T.fit_forest(
+            Xb_s, Y_s, w_s, b.thresholds, axis_name="data", **kw
+        )
+
+    got = sharded(Xb, Y, w)
+    ref = T.fit_forest(Xb, Y, w, b.thresholds, **kw)
+    np.testing.assert_array_equal(
+        np.asarray(got.split_feature), np.asarray(ref.split_feature)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.leaf_value), np.asarray(ref.leaf_value),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_fit_tree_stream_delegates(monkeypatch):
+    """Single-tree hist='stream' (the fused path's M=1 case) matches the
+    dense single-tree fit."""
+    import spark_ensemble_tpu.ops.tree as T
+
+    monkeypatch.setattr(T, "_STREAM_CHUNK_ROWS", 256)
+    X, y = _data(n=900, d=5, seed=23)
+    b = compute_bins(jnp.asarray(X), 16)
+    Xb = bin_features(jnp.asarray(X), b)
+    w = jnp.ones((X.shape[0],))
+    kw = dict(max_depth=4, max_bins=16)
+    dense = fit_tree(
+        Xb, jnp.asarray(y)[:, None], w, b.thresholds, hist="matmul", **kw
+    )
+    stream = fit_tree(
+        Xb, jnp.asarray(y)[:, None], w, b.thresholds, hist="stream", **kw
+    )
+    np.testing.assert_array_equal(
+        np.asarray(dense.split_feature), np.asarray(stream.split_feature)
+    )
+    np.testing.assert_allclose(
+        np.asarray(dense.leaf_value), np.asarray(stream.leaf_value),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_resolve_hist_auto_prefers_stream_past_matmul_budget(monkeypatch):
+    """On accelerator backends the auto policy takes the stream tier (not
+    the serializing scatter path) once the bin-one-hot outgrows its
+    budget; CPU keeps segment_sum at any n."""
+    import spark_ensemble_tpu.ops.tree as T
+
+    monkeypatch.setattr(T.jax, "default_backend", lambda: "tpu")
+    small = T._resolve_hist("auto", 10_000, 16, 64)
+    big = T._resolve_hist("auto", 4_000_000, 64, 64)
+    assert (small, big) == ("matmul", "stream")
+    monkeypatch.setattr(T.jax, "default_backend", lambda: "cpu")
+    assert T._resolve_hist("auto", 4_000_000, 64, 64) == "scatter"
+
+
+def test_stream_wins_over_pallas_precision(monkeypatch):
+    """hist='stream' + hist_precision='pallas': the stream tier must be
+    honored (its statistics run at the 'high' precision pallas maps to),
+    not silently rerouted through the dense pallas/per-tree path whose
+    one-hot operands the stream setting exists to avoid."""
+    import spark_ensemble_tpu.ops.tree as T
+
+    monkeypatch.setattr(T, "_STREAM_CHUNK_ROWS", 128)
+    rng = np.random.RandomState(25)
+    n, d, M, B = 700, 4, 2, 16
+    X = jnp.asarray(rng.randn(n, d).astype(np.float32))
+    b = compute_bins(X, B)
+    Xb = bin_features(X, b)
+    Y = jnp.asarray(rng.randn(n, M, 1).astype(np.float32))
+    w = jnp.ones((n, M))
+    kw = dict(max_depth=3, max_bins=B)
+    got = T.fit_forest(
+        Xb, Y, w, b.thresholds, hist="stream", hist_precision="pallas", **kw
+    )
+    ref = T.fit_forest(
+        Xb, Y, w, b.thresholds, hist="stream", hist_precision="high", **kw
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got.split_feature), np.asarray(ref.split_feature)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.leaf_value), np.asarray(ref.leaf_value), rtol=1e-5
+    )
+
+
+def test_stream_param_validated_and_plumbed():
+    import spark_ensemble_tpu as se
+
+    est = se.DecisionTreeRegressor(hist="stream")
+    assert est.hist == "stream"
+    with pytest.raises(ValueError):
+        se.DecisionTreeRegressor(hist="nope")
+    # estimator-level: a small stream-tier GBM fit tracks the default fit
+    rng = np.random.RandomState(24)
+    X = rng.randn(700, 6).astype(np.float32)
+    yc = (X[:, 0] + 0.3 * rng.randn(700) > 0).astype(np.float32)
+    cfg = dict(num_base_learners=3, learning_rate=0.5, seed=0)
+    a_ref = float(np.mean(np.asarray(
+        se.GBMClassifier(**cfg).fit(X, yc).predict(X)) == yc))
+    a_st = float(np.mean(np.asarray(
+        se.GBMClassifier(
+            base_learner=se.DecisionTreeRegressor(hist="stream"), **cfg
+        ).fit(X, yc).predict(X)) == yc))
+    assert abs(a_ref - a_st) < 0.02, (a_ref, a_st)
