@@ -1,0 +1,58 @@
+"""The generated API reference (tools/gen_api_docs.py) must cover every
+public export — the parity bar is the reference's scaladoc navbar item
+(`website/docusaurus.config.js:19` there), where every public class gets a
+generated page."""
+
+import importlib.util
+import os
+
+import spark_ensemble_tpu as se
+
+_GEN = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools", "gen_api_docs.py",
+)
+
+
+def _load_gen():
+    spec = importlib.util.spec_from_file_location("gen_api_docs", _GEN)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_every_public_export_gets_a_page(tmp_path):
+    gen = _load_gen()
+    pages = gen.generate(str(tmp_path))
+    covered = {name for names in pages.values() for name in names}
+    assert covered == set(se.__all__)
+    # one file per page plus the index
+    files = {p.name for p in tmp_path.iterdir()}
+    assert files == {f"{page}.md" for page in pages} | {"index.md"}
+
+
+def test_estimator_pages_render_param_tables(tmp_path):
+    gen = _load_gen()
+    gen.generate(str(tmp_path))
+    gbm = (tmp_path / "gbm.md").read_text()
+    assert "## `GBMClassifier`" in gbm
+    assert "| `num_base_learners` | `10` |" in gbm
+    assert "#### `fit(" in gbm
+    # the index links every page
+    index = (tmp_path / "index.md").read_text()
+    for page in ("gbm", "bagging", "stacking", "tree"):
+        assert f"[{page}](./{page}.md)" in index
+
+
+def test_committed_pages_match_the_code(tmp_path):
+    """The repo's docs/api must be regeneration-stable (CI enforces the
+    same thing with git diff --exit-code)."""
+    gen = _load_gen()
+    gen.generate(str(tmp_path))
+    committed = os.path.join(os.path.dirname(_GEN), "..", "docs", "api")
+    # listings must match exactly: an orphaned committed page (module
+    # renamed/removed) is as stale as a modified one
+    assert {p.name for p in tmp_path.iterdir()} == set(os.listdir(committed))
+    for p in sorted(tmp_path.iterdir()):
+        with open(os.path.join(committed, p.name)) as f:
+            assert f.read() == p.read_text(), f"{p.name} is stale"

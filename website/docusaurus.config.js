@@ -17,6 +17,9 @@ module.exports = {
       title: 'spark-ensemble-tpu',
       items: [
         { to: 'docs/overview', label: 'Documentation', position: 'right' },
+        // generated API reference (tools/gen_api_docs.py), the analogue
+        // of the reference's scaladoc navbar item
+        { to: 'docs/api/index', label: 'API', position: 'right' },
       ],
     },
     colorMode: {
