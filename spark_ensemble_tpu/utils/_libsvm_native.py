@@ -19,8 +19,10 @@ _LIB = None
 
 
 def _source_path() -> str:
-    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    return os.path.join(here, "csrc", "libsvm_parser.cpp")
+    # the source ships INSIDE the package (pyproject package-data) so
+    # wheel installs keep the native fast path, not just repo checkouts
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(pkg, "csrc", "libsvm_parser.cpp")
 
 
 def _build_lib() -> ctypes.CDLL:
@@ -30,9 +32,16 @@ def _build_lib() -> ctypes.CDLL:
     src = _source_path()
     if not os.path.exists(src):
         raise ImportError("csrc/libsvm_parser.cpp not found")
-    # build artifact lives next to the source tree (user-owned), never in a
-    # shared world-writable location; fall back to a fresh private tempdir
-    cache_dir = os.path.join(os.path.dirname(os.path.dirname(src)), "build", "native")
+    # build artifact lives in the user's cache (never inside site-packages
+    # — the package's own tree may be read-only and a stray top-level dir
+    # there would outlive an uninstall, and never in a shared
+    # world-writable location); fall back to a fresh private tempdir
+    cache_dir = os.path.join(
+        os.environ.get(
+            "XDG_CACHE_HOME", os.path.join(os.path.expanduser("~"), ".cache")
+        ),
+        "spark_ensemble_tpu", "native",
+    )
     try:
         os.makedirs(cache_dir, exist_ok=True)
     except OSError:
