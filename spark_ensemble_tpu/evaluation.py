@@ -63,7 +63,10 @@ def _regression_metrics(pred, y, w):
 class RegressionEvaluator(Evaluator):
     """Metrics rmse|mse|mae|r2|var (Spark ``RegressionEvaluator`` set)."""
 
-    metric = Param("rmse", in_array(["rmse", "mse", "mae", "r2", "var"]))
+    metric = Param(
+        "rmse", in_array(["rmse", "mse", "mae", "r2", "var"]),
+        doc="regression metric (Spark RegressionEvaluator names)",
+    )
 
     @property
     def is_larger_better(self):
@@ -108,6 +111,8 @@ class MulticlassClassificationEvaluator(Evaluator):
                 "hammingloss",
             ]
         ),
+        doc="multiclass metric (Spark MulticlassClassificationEvaluator "
+        "names); f1 is the actual-frequency-weighted mean of per-class F1",
     )
     eps = Param(1e-15, gt_eq(0.0), doc="probability clamp for logLoss (Spark default)")
 
@@ -204,7 +209,10 @@ class BinaryClassificationEvaluator(Evaluator):
     """areaUnderROC | areaUnderPR via trapezoidal integration over the
     weighted score-ranked curves (Spark ``BinaryClassificationEvaluator``)."""
 
-    metric = Param("areaunderroc", in_array(["areaunderroc", "areaunderpr"]))
+    metric = Param(
+        "areaunderroc", in_array(["areaunderroc", "areaunderpr"]),
+        doc="threshold-free binary metric over raw scores/probabilities",
+    )
 
     is_larger_better = True
 

@@ -52,13 +52,27 @@ from spark_ensemble_tpu.utils.random import bootstrap_weights, subspace_mask
 class _BaggingParams(Estimator):
     """Reference `BaggingParams.scala:27-37` + `HasSubBag.scala:69-71`."""
 
-    base_learner = Param(None, is_estimator=True)
-    num_base_learners = Param(10, gt_eq(1))
-    replacement = Param(True)
-    subsample_ratio = Param(1.0, in_range(0.0, 1.0, lower_inclusive=False))
-    subspace_ratio = Param(1.0, in_range(0.0, 1.0, lower_inclusive=False))
+    base_learner = Param(
+        None, is_estimator=True,
+        doc="learner template copied per member; defaults to a depth-5 "
+        "histogram decision tree",
+    )
+    num_base_learners = Param(10, gt_eq(1), doc="ensemble size")
+    replacement = Param(
+        True,
+        doc="bootstrap with replacement (Poisson sample weights) vs "
+        "without (Bernoulli); reference SubBag semantics",
+    )
+    subsample_ratio = Param(
+        1.0, in_range(0.0, 1.0, lower_inclusive=False),
+        doc="per-member row sample ratio (enters as weights, not subsets)",
+    )
+    subspace_ratio = Param(
+        1.0, in_range(0.0, 1.0, lower_inclusive=False),
+        doc="per-member feature-subspace ratio (random subspaces)",
+    )
     parallelism = Param(1, gt_eq(1), doc="API parity; members are vmapped")
-    seed = Param(0)
+    seed = Param(0, doc="PRNG seed for member sampling plans")
 
     def _member_plan(self, n: int, d: int, w: jax.Array):
         """Stacked per-member (fit weights, masks, keys), drawn in ONE
@@ -240,7 +254,11 @@ class BaggingRegressionModel(RegressionModel, BaggingRegressor):
 
 
 class BaggingClassifier(_BaggingParams):
-    voting_strategy = Param("hard", in_array(["hard", "soft"]))
+    voting_strategy = Param(
+        "hard", in_array(["hard", "soft"]),
+        doc="'hard' majority-votes member classes; 'soft' averages "
+        "member probabilities",
+    )
 
     is_classifier = True
 

@@ -91,8 +91,16 @@ EPSILON = 2.220446049250313e-16  # Spark MLUtils.EPSILON (double ulp of 1.0)
 class _BoostingParams(CheckpointableParams, Estimator):
     """Reference `BoostingParams.scala:26-37`."""
 
-    base_learner = Param(None, is_estimator=True)
-    num_base_learners = Param(10, gt_eq(1))
+    base_learner = Param(
+        None, is_estimator=True,
+        doc="weak learner fitted per round on reweighted rows; defaults "
+        "to a depth-5 histogram decision tree",
+    )
+    num_base_learners = Param(
+        10, gt_eq(1),
+        doc="maximum boosting rounds (fits may stop early on a round-0 "
+        "abort, reference Boosting.scala semantics)",
+    )
     scan_chunk = Param(
         16,
         gt_eq(1),
@@ -116,7 +124,9 @@ class _BoostingParams(CheckpointableParams, Estimator):
         "scan_chunk - 1 discarded fits on an abort).  SAMME.R has no "
         "error-threshold abort and always runs full chunks",
     )
-    checkpoint_interval = Param(10, gt_eq(1))
+    checkpoint_interval = Param(
+        10, gt_eq(1), doc="rounds between training-state checkpoints"
+    )
     checkpoint_dir = Param(
         None,
         doc="when set, training state (round, members, boosting weights) is "
@@ -126,7 +136,7 @@ class _BoostingParams(CheckpointableParams, Estimator):
         "202-206`, SURVEY.md §5)",
     )
     aggregation_depth = Param(2, gt_eq(1), doc="API parity; reductions are psum")
-    seed = Param(0)
+    seed = Param(0, doc="PRNG seed for the weighted resampling plans")
 
     def _drive_boosting_rounds(
         self,
@@ -200,7 +210,11 @@ class _BoostingParams(CheckpointableParams, Estimator):
 
 
 class BoostingClassifier(_BoostingParams):
-    algorithm = Param("discrete", in_array(["discrete", "real"]))
+    algorithm = Param(
+        "discrete", in_array(["discrete", "real"]),
+        doc="'discrete' = SAMME (class votes), 'real' = SAMME.R "
+        "(probability-weighted log-odds votes)",
+    )
 
     is_classifier = True
 
@@ -440,8 +454,15 @@ class BoostingClassificationModel(ClassificationModel, BoostingClassifier):
 
 
 class BoostingRegressor(_BoostingParams):
-    loss = Param("exponential", in_array(["exponential", "linear", "squared"]))
-    voting_strategy = Param("median", in_array(["median", "mean"]))
+    loss = Param(
+        "exponential", in_array(["exponential", "linear", "squared"]),
+        doc="Drucker R2 per-row loss shaping of the normalized errors",
+    )
+    voting_strategy = Param(
+        "median", in_array(["median", "mean"]),
+        doc="'median' = weighted median of member predictions (Drucker), "
+        "'mean' = confidence-weighted mean",
+    )
 
     is_classifier = False
 

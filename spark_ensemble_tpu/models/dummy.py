@@ -29,9 +29,15 @@ from spark_ensemble_tpu.utils.quantile import weighted_median, weighted_quantile
 
 
 class DummyRegressor(BaseLearner):
-    strategy = Param("mean", in_array(["mean", "median", "quantile", "constant"]))
-    quantile = Param(0.5, in_range(0.0, 1.0))
-    constant = Param(0.0)
+    strategy = Param(
+        "mean", in_array(["mean", "median", "quantile", "constant"]),
+        doc="constant prediction rule over the training target",
+    )
+    quantile = Param(
+        0.5, in_range(0.0, 1.0),
+        doc="target quantile for strategy='quantile' (exact, weighted)",
+    )
+    constant = Param(0.0, doc="value for strategy='constant'")
     tol = Param(1e-3, gt_eq(0.0), doc="kept for API parity; quantiles are exact")
 
     is_classifier = False
@@ -68,8 +74,12 @@ class DummyRegressionModel(RegressionModel, DummyRegressor):
 
 
 class DummyClassifier(BaseLearner):
-    strategy = Param("prior", in_array(["uniform", "prior", "constant"]))
-    constant = Param(0.0)
+    strategy = Param(
+        "prior", in_array(["uniform", "prior", "constant"]),
+        doc="'prior' predicts the modal class with class-frequency "
+        "probabilities; 'uniform' ignores the training distribution",
+    )
+    constant = Param(0.0, doc="class label for strategy='constant'")
 
     is_classifier = True
 

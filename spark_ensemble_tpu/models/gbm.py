@@ -112,19 +112,58 @@ def concat_pytrees(chunks: List[Any]):
 class _GBMParams(CheckpointableParams, Estimator):
     """Shared GBM params (reference `GBMParams.scala:29-137` defaults)."""
 
-    base_learner = Param(None, is_estimator=True)
-    num_base_learners = Param(10, gt_eq(1))
-    learning_rate = Param(1.0, gt(0.0))
-    optimized_weights = Param(True)
-    updates = Param("gradient", in_array(["gradient", "newton"]))
-    subsample_ratio = Param(1.0, in_range(0.0, 1.0, lower_inclusive=False))
-    replacement = Param(False)
-    subspace_ratio = Param(1.0, in_range(0.0, 1.0, lower_inclusive=False))
-    max_iter = Param(100, gt_eq(1))
-    tol = Param(1e-6, gt_eq(0.0))
-    num_rounds = Param(1, gt_eq(1))
-    validation_tol = Param(0.01, gt_eq(0.0))
-    seed = Param(0)
+    base_learner = Param(
+        None, is_estimator=True,
+        doc="base learner fitted each round on the pseudo-residuals "
+        "(snapshot-copied per round); defaults to a depth-5 histogram "
+        "DecisionTreeRegressor",
+    )
+    num_base_learners = Param(
+        10, gt_eq(1), doc="boosting rounds (reference maxIter analogue)"
+    )
+    learning_rate = Param(
+        1.0, gt(0.0), doc="shrinkage applied to each round's step"
+    )
+    optimized_weights = Param(
+        True,
+        doc="line-search the per-round step size(s): Brent (closed-form "
+        "for squared loss) for regression, projected-Newton box search "
+        "over the class dims for classification; False uses 1.0",
+    )
+    updates = Param(
+        "gradient", in_array(["gradient", "newton"]),
+        doc="pseudo-residual rule: 'gradient' fits -g, 'newton' fits "
+        "-g/h with the hessian floor/scaling of GBMClassifier.scala",
+    )
+    subsample_ratio = Param(
+        1.0, in_range(0.0, 1.0, lower_inclusive=False),
+        doc="per-round row subsample (stochastic gradient boosting); "
+        "enters as Poisson/Bernoulli weights, not row subsets",
+    )
+    replacement = Param(
+        False, doc="subsample with replacement (Poisson weights)"
+    )
+    subspace_ratio = Param(
+        1.0, in_range(0.0, 1.0, lower_inclusive=False),
+        doc="per-round feature-subspace ratio (random subspaces mask "
+        "split validity; predictions re-index through the mask)",
+    )
+    max_iter = Param(
+        100, gt_eq(1), doc="line-search iteration cap per round"
+    )
+    tol = Param(1e-6, gt_eq(0.0), doc="line-search convergence tolerance")
+    tol = Param(1e-6, gt_eq(0.0), doc="line-search convergence tolerance")
+    num_rounds = Param(
+        1, gt_eq(1),
+        doc="early-stop patience: stop after this many consecutive "
+        "rounds without validation improvement > validation_tol",
+    )
+    validation_tol = Param(
+        0.01, gt_eq(0.0),
+        doc="minimum relative validation-loss improvement that resets "
+        "the early-stop patience counter",
+    )
+    seed = Param(0, doc="PRNG seed for sampling plans")
     aggregation_depth = Param(2, gt_eq(1), doc="API parity; reductions are psum")
     scan_chunk = Param(
         16,
@@ -135,7 +174,9 @@ class _GBMParams(CheckpointableParams, Estimator):
         "changing round math (validation early-stop still applies per "
         "round, overshooting at most one chunk of compute)",
     )
-    checkpoint_interval = Param(10, gt_eq(1))
+    checkpoint_interval = Param(
+        10, gt_eq(1), doc="rounds between training-state checkpoints"
+    )
     checkpoint_dir = Param(
         None,
         doc="when set, training state (round, members, predictions, patience) "
@@ -309,8 +350,16 @@ class GBMRegressor(_GBMParams):
         "scaledlogcosh are exposed as extensions (present in GBMLoss.scala "
         "but not surfaced by GBMRegressorParams)",
     )
-    alpha = Param(0.9, in_range(0.0, 1.0))
-    init_strategy = Param("constant", in_array(["constant", "zero", "base"]))
+    alpha = Param(
+        0.9, in_range(0.0, 1.0),
+        doc="huber/quantile shape parameter (adaptive huber delta "
+        "re-quantiles the residuals each round)",
+    )
+    init_strategy = Param(
+        "constant", in_array(["constant", "zero", "base"]),
+        doc="round-0 prediction: weighted target constant, zero, or a "
+        "fitted copy of the base learner",
+    )
 
     is_classifier = False
 
@@ -796,8 +845,15 @@ class GBMClassifier(_GBMParams):
     round (class-dim vmap), K-dim box-constrained line search, raw-score
     prediction state."""
 
-    loss = Param("logloss", in_array(["logloss", "exponential", "bernoulli"]))
-    init_strategy = Param("prior", in_array(["prior", "uniform"]))
+    loss = Param(
+        "logloss", in_array(["logloss", "exponential", "bernoulli"]),
+        doc="K-class softmax cross-entropy, or the reference's binary "
+        "exponential / bernoulli losses on (-f, f) raw scores",
+    )
+    init_strategy = Param(
+        "prior", in_array(["prior", "uniform"]),
+        doc="round-0 raw scores: class-prior log-odds or zeros",
+    )
 
     is_classifier = True
 

@@ -57,8 +57,8 @@ def _feature_stats(X, w, axis_name=None):
 
 
 class LinearRegression(BaseLearner):
-    reg_param = Param(1e-6, gt_eq(0.0))
-    fit_intercept = Param(True)
+    reg_param = Param(1e-6, gt_eq(0.0), doc="L2 ridge strength")
+    fit_intercept = Param(True, doc="learn a bias column")
 
     is_classifier = False
 
@@ -270,9 +270,9 @@ _NEWTON_MAX_PARAMS = 1024
 
 class LogisticRegression(BaseLearner):
     reg_param = Param(1e-6, gt_eq(0.0), doc="L2 penalty")
-    fit_intercept = Param(True)
-    max_iter = Param(100, gt_eq(1))
-    tol = Param(1e-6, gt_eq(0.0))
+    fit_intercept = Param(True, doc="learn a bias column")
+    max_iter = Param(100, gt_eq(1), doc="solver iteration cap")
+    tol = Param(1e-6, gt_eq(0.0), doc="gradient-norm convergence tolerance")
     solver = Param(
         "auto",
         in_array(["auto", "newton", "lbfgs"]),

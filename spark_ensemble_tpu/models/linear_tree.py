@@ -60,7 +60,11 @@ class LinearTreeRegressor(DecisionTreeRegressor):
     )
     # the leaf one-hot materializes [n, 2^depth] and the path matrix grows
     # 4^depth (ops.tree leaf_one_hot); cap at the matmul-predict depth
-    max_depth = Param(5, in_range(1, 10))
+    max_depth = Param(
+        5, in_range(1, 10),
+        doc="tree depth (shallower cap than constant-leaf trees: every "
+        "leaf carries a d+1-dim ridge model)",
+    )
 
     def make_fit_ctx(self, X, num_classes=None):
         ctx = super().make_fit_ctx(X, num_classes)

@@ -59,7 +59,9 @@ class _MLPBase(BaseLearner):
         doc="widths of the hidden layers (static topology: part of the "
         "compiled program's shape, like Spark MLP's `layers` param)",
     )
-    activation = Param("relu", in_array(["relu", "tanh"]))
+    activation = Param(
+        "relu", in_array(["relu", "tanh"]), doc="hidden-layer nonlinearity"
+    )
     max_iter = Param(
         200,
         gt_eq(1),
@@ -67,9 +69,9 @@ class _MLPBase(BaseLearner):
         "fits stay fusable — convergence-based stopping would make the "
         "program shape data-dependent",
     )
-    learning_rate_init = Param(1e-2, gt(0.0))
+    learning_rate_init = Param(1e-2, gt(0.0), doc="Adam learning rate")
     reg_param = Param(1e-4, gt_eq(0.0), doc="L2 penalty on weights (not biases)")
-    seed = Param(0)
+    seed = Param(0, doc="weight-init PRNG seed")
 
     def _sizes(self, d: int, out_dim: int):
         return (d, *[int(h) for h in self.hidden_layer_sizes], out_dim)

@@ -24,7 +24,11 @@ from spark_ensemble_tpu.params import Param, gt_eq
 
 
 class GaussianNaiveBayes(BaseLearner):
-    var_smoothing = Param(1e-6, gt_eq(0.0))
+    var_smoothing = Param(
+        1e-6, gt_eq(0.0),
+        doc="fraction of the largest feature variance added to every "
+        "per-class variance for numerical stability",
+    )
 
     is_classifier = True
 

@@ -52,8 +52,16 @@ logger = logging.getLogger(__name__)
 class _StackingParams(Estimator):
     """Reference `StackingParams.scala:22-27`."""
 
-    base_learners = Param(None, is_estimator=True)
-    stacker = Param(None, is_estimator=True)
+    base_learners = Param(
+        None, is_estimator=True,
+        doc="heterogeneous level-0 learner list (each fitted on the full "
+        "training split); defaults per task in fit()",
+    )
+    stacker = Param(
+        None, is_estimator=True,
+        doc="level-1 meta-learner fitted on the members' outputs; "
+        "defaults to a linear/logistic model",
+    )
     parallelism = Param(
         1,
         doc="max concurrent base-learner fits — the analogue of the "
@@ -62,7 +70,7 @@ class _StackingParams(Estimator):
         "trace/compile in parallel threads and XLA overlaps their "
         "device programs",
     )
-    seed = Param(0)
+    seed = Param(0, doc="PRNG seed (member fits are deterministic)")
 
     def _fit_bases(
         self, bases, X, y, w, sample_weight, num_classes=None, mesh=None
@@ -170,7 +178,11 @@ class StackingRegressionModel(RegressionModel, StackingRegressor):
 
 
 class StackingClassifier(_StackingParams):
-    stack_method = Param("class", in_array(["class", "raw", "proba"]))
+    stack_method = Param(
+        "class", in_array(["class", "raw", "proba"]),
+        doc="meta-features fed to the stacker: predicted classes, raw "
+        "scores, or class probabilities (reference StackingParams)",
+    )
 
     is_classifier = True
 

@@ -35,9 +35,18 @@ from spark_ensemble_tpu.params import Param, gt_eq, in_array, in_range
 
 
 class _TreeLearner(BaseLearner):
-    max_depth = Param(5, in_range(1, 20))
-    max_bins = Param(64, gt_eq(2))
-    min_info_gain = Param(0.0, gt_eq(0.0))
+    max_depth = Param(
+        5, in_range(1, 20),
+        doc="tree depth; the dense heap layout always allocates "
+        "2^max_depth leaves (static shapes)",
+    )
+    max_bins = Param(
+        64, gt_eq(2),
+        doc="histogram bins per feature (quantile binning at fit time)",
+    )
+    min_info_gain = Param(
+        0.0, gt_eq(0.0), doc="minimum split gain; below it a node leafs"
+    )
     hist_precision = Param(
         "highest",
         in_array(["highest", "high", "default", "pallas"]),
@@ -62,7 +71,7 @@ class _TreeLearner(BaseLearner):
         "per-level traffic is one read of the compact binned features "
         "instead of materialized full-n one-hots.",
     )
-    seed = Param(0)
+    seed = Param(0, doc="unused by the deterministic kernels; API parity")
 
     def make_fit_ctx(self, X, num_classes=None):
         X = as_f32(X)

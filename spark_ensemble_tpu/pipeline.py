@@ -39,8 +39,8 @@ class Transformer(Params):
 class StandardScaler(Estimator):
     """Column standardization (Spark ``ml.feature.StandardScaler``)."""
 
-    with_mean = Param(True)
-    with_std = Param(True)
+    with_mean = Param(True, doc="center features at the training mean")
+    with_std = Param(True, doc="scale features to unit training variance")
 
     def fit(self, X, y=None, sample_weight=None) -> "StandardScalerModel":
         X = as_f32(X)
@@ -69,8 +69,8 @@ class StandardScalerModel(Model, StandardScaler):
 class MinMaxScaler(Estimator):
     """Rescale columns to [min, max] (Spark ``ml.feature.MinMaxScaler``)."""
 
-    feature_min = Param(0.0)
-    feature_max = Param(1.0)
+    feature_min = Param(0.0, doc="lower bound of the scaled range")
+    feature_max = Param(1.0, doc="upper bound of the scaled range")
 
     def fit(self, X, y=None, sample_weight=None) -> "MinMaxScalerModel":
         X = as_f32(X)
@@ -109,7 +109,10 @@ class Pipeline(Estimator):
     models exposing ``transform``), already-fitted transformers, or a final
     predictor estimator."""
 
-    stages = Param(None, is_estimator=True)
+    stages = Param(
+        None, is_estimator=True,
+        doc="ordered transformers + final estimator, Spark Pipeline style",
+    )
 
     @property
     def is_classifier(self):

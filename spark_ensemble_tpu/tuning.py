@@ -112,11 +112,17 @@ def _fit_and_eval(
 
 
 class _TuningParams(Estimator):
-    estimator = Param(None, is_estimator=True)
-    evaluator = Param(None, is_estimator=True)
-    estimator_param_maps = Param(None)
+    estimator = Param(None, is_estimator=True, doc="estimator to tune")
+    evaluator = Param(
+        None, is_estimator=True,
+        doc="metric (RegressionEvaluator / *ClassificationEvaluator); "
+        "its is_larger_better drives model selection",
+    )
+    estimator_param_maps = Param(
+        None, doc="list of param dicts (ParamGridBuilder.build())"
+    )
     parallelism = Param(1, gt_eq(1), doc="API parity; fits run back-to-back")
-    seed = Param(0)
+    seed = Param(0, doc="fold-split PRNG seed")
 
     def _maps(self) -> List[Dict[str, Any]]:
         return list(self.estimator_param_maps or [{}])
@@ -125,7 +131,7 @@ class _TuningParams(Estimator):
 class CrossValidator(_TuningParams):
     """k-fold CV over a param grid (Spark ``CrossValidator``)."""
 
-    num_folds = Param(3, gt_eq(2))
+    num_folds = Param(3, gt_eq(2), doc="cross-validation folds")
 
     def fit(self, X, y, sample_weight=None, mesh=None) -> "CrossValidatorModel":
         """Fit; ``mesh`` flows into every (param-map, fold) estimator fit,
@@ -191,7 +197,11 @@ class CrossValidatorModel(Model, CrossValidator):
 class TrainValidationSplit(_TuningParams):
     """Single random train/validation split sweep (Spark ``TrainValidationSplit``)."""
 
-    train_ratio = Param(0.75, in_range(0.0, 1.0, lower_inclusive=False, upper_inclusive=False))
+    train_ratio = Param(
+        0.75,
+        in_range(0.0, 1.0, lower_inclusive=False, upper_inclusive=False),
+        doc="fraction of rows in the training split",
+    )
 
     def fit(
         self, X, y, sample_weight=None, mesh=None
