@@ -165,6 +165,18 @@ def infer_num_classes(y, num_classes: Optional[int] = None) -> int:
     return max(k, 2)
 
 
+def member_leaves(base) -> int:
+    """Chunk-budget heuristic for ``ops.tree.predict_chunked_rows``: leaves
+    the base learner's FUSED predict routes through (1 for non-tree
+    learners — chunking is then harmless headroom).  Capped at the fused
+    path's depth limit: deeper trees take the unfused walk fallback, which
+    never builds the [rows, members, leaves] one-hot being budgeted."""
+    from spark_ensemble_tpu.ops.tree import _MATMUL_PREDICT_MAX_DEPTH
+
+    depth = int(getattr(base, "max_depth", 0) or 0)
+    return 2 ** min(depth, _MATMUL_PREDICT_MAX_DEPTH)
+
+
 class Model(Params):
     """A fitted model: estimator config + learned params pytree."""
 

@@ -68,9 +68,11 @@ from spark_ensemble_tpu.models.base import (
     as_f32,
     cached_program,
     infer_num_classes,
+    member_leaves,
     mesh_fit_kwargs,
     resolve_weights,
 )
+from spark_ensemble_tpu.ops.tree import predict_chunked_rows
 from spark_ensemble_tpu.models.dummy import DummyClassifier, DummyRegressor
 from spark_ensemble_tpu.models.tree import DecisionTreeRegressor
 from spark_ensemble_tpu.ops import losses as losses_mod
@@ -812,12 +814,17 @@ class GBMRegressionModel(RegressionModel, GBMRegressor):
         if self.num_members == 0:
             return out
         base = self._base()
-        fn = self._cached_jit(
-            "predict",
-            lambda members, weights, Xq: jnp.einsum(
-                "m,mn->n", weights, base.predict_many_fn(members, Xq)
-            ),
-        )
+        leaves = member_leaves(base)
+
+        def pred(members, weights, Xq):
+            return predict_chunked_rows(
+                lambda Xc: jnp.einsum(
+                    "m,mn->n", weights, base.predict_many_fn(members, Xc)
+                ),
+                Xq, weights.shape[0], leaves,
+            )
+
+        fn = self._cached_jit("predict", pred)
         return out + fn(self.params["members"], self.params["weights"], X)
 
     def take(self, k: int) -> "GBMRegressionModel":
@@ -1355,8 +1362,12 @@ class GBMClassificationModel(ClassificationModel, GBMClassifier):
             flat = jax.tree_util.tree_map(
                 lambda x: x.reshape((r * dim,) + x.shape[2:]), members
             )
-            preds = base.predict_many_fn(flat, Xq).reshape(r, dim, -1)
-            return jnp.einsum("md,mdn->nd", weights, preds)
+
+            def one(Xc):
+                preds = base.predict_many_fn(flat, Xc).reshape(r, dim, -1)
+                return jnp.einsum("md,mdn->nd", weights, preds)
+
+            return predict_chunked_rows(one, Xq, r * dim, member_leaves(base))
 
         fn = self._cached_jit("raw", raw)
         return out + fn(self.params["members"], self.params["weights"], X)

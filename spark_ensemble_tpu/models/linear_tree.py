@@ -40,6 +40,7 @@ from spark_ensemble_tpu.ops.tree import (
     feature_gains,
     leaf_one_hot,
     leaf_one_hot_forest,
+    predict_chunked_rows,
 )
 from spark_ensemble_tpu.params import Param, gt_eq, in_range
 
@@ -233,29 +234,46 @@ class LinearTreeRegressor(DecisionTreeRegressor):
         re-stream X per member (the pattern ``predict_forest`` documents as
         bandwidth-bound)."""
         X = as_f32(X)
-        finite_row = jnp.isfinite(X).all(axis=1)  # [n]
-        Xc = jnp.nan_to_num(
-            X, nan=_F32_MAX, posinf=_F32_MAX, neginf=-_F32_MAX
-        )
-        oh = leaf_one_hot_forest(params["tree"], Xc, binned=False)  # [n,M,L]
-        beta_row = jnp.einsum(
-            "nml,mlD->nmD",
-            oh,
-            params["beta"],
-            precision=(jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST),
-        )  # [n, M, d+1]
-        Xs = (
-            Xc[:, None, :] * params["mask"][None, :, :]
-            - params["x_mu"][None, :, :]
-        ) / params["x_sd"][None, :, :]  # [n, M, d]
-        lin = jnp.sum(Xs * beta_row[:, :, :-1], axis=-1) + beta_row[:, :, -1]
-        const = jnp.einsum(
-            "nml,ml->nm",
-            oh,
-            params["tree"].leaf_value[:, :, 0],
-            precision=(jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST),
-        )
-        return jnp.where(finite_row[:, None], lin, const).T  # [M, n]
+        M = params["tree"].split_feature.shape[0]
+        L = params["tree"].leaf_value.shape[1]
+
+        def rows(Xr):
+            finite_row = jnp.isfinite(Xr).all(axis=1)  # [n]
+            Xc = jnp.nan_to_num(
+                Xr, nan=_F32_MAX, posinf=_F32_MAX, neginf=-_F32_MAX
+            )
+            oh = leaf_one_hot_forest(
+                params["tree"], Xc, binned=False
+            )  # [n,M,L]
+            beta_row = jnp.einsum(
+                "nml,mlD->nmD",
+                oh,
+                params["beta"],
+                precision=(
+                    jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST
+                ),
+            )  # [n, M, d+1]
+            Xs = (
+                Xc[:, None, :] * params["mask"][None, :, :]
+                - params["x_mu"][None, :, :]
+            ) / params["x_sd"][None, :, :]  # [n, M, d]
+            lin = (
+                jnp.sum(Xs * beta_row[:, :, :-1], axis=-1)
+                + beta_row[:, :, -1]
+            )
+            const = jnp.einsum(
+                "nml,ml->nm",
+                oh,
+                params["tree"].leaf_value[:, :, 0],
+                precision=(
+                    jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST
+                ),
+            )
+            return jnp.where(finite_row[:, None], lin, const)  # [n, M]
+
+        # row-chunked past the one-hot budget (see ops/tree.py
+        # predict_chunked_rows; same guard as predict_forest)
+        return predict_chunked_rows(rows, X, M, L).T  # [M, n]
 
     def feature_gains_fn(self, params, d: int):
         # importances come from the tree's split gains (the leaf models

@@ -142,8 +142,13 @@ def _prefix_sums(hist_w, hist_wy, bins_axis_w, stat_prec, hist):
     order identity away anyway, so they compute the prefix sums as ONE
     batched matmul against a triangular 0/1 matrix — an MXU op instead of
     a sequential scan, attacking the per-level cumsum tail in the round
-    profile.  The tier policy lives HERE, next to the code it selects."""
-    fast_tier = hist == "matmul" and stat_prec != jax.lax.Precision.HIGHEST
+    profile.  The tier policy lives HERE, next to the code it selects.
+    The stream tier's histograms are the same matmul statistics (chunk-
+    accumulated), so its fast tiers take the same triangular form."""
+    fast_tier = (
+        hist in ("matmul", "stream")
+        and stat_prec != jax.lax.Precision.HIGHEST
+    )
     if not fast_tier:
         return (
             jnp.cumsum(hist_w, axis=bins_axis_w),
@@ -583,6 +588,32 @@ _FOREST_FUSED_MAX_CELLS = 2**28
 # keeping the matmul's contraction dim MXU-sized
 _STREAM_CHUNK_ROWS = 32768
 
+# rows * members * leaves budget of the fused predict routing one-hot
+# (leaf_one_hot_forest); past it predict paths lax.map over row chunks —
+# HBM-scale inference (~200 GB of one-hot at n=2M for a 100-round 8-class
+# GBM if unchunked).  ONE constant and ONE helper for every layer:
+# predict_forest chunks internally, and model predicts that reduce members
+# inside their chunk call predict_chunked_rows directly.
+_PREDICT_FUSED_MAX_CELLS = 2**27
+
+
+def predict_chunked_rows(fn, Xq, n_members, leaves):
+    """Apply ``fn`` (a per-chunk ``[rows, d] -> [rows, ...]`` predict whose
+    member reduction — if any — happens INSIDE) over row chunks sized so
+    the fused forest predict's ``[rows, members, leaves]`` one-hot stays
+    under ``_PREDICT_FUSED_MAX_CELLS``; single direct call when it already
+    fits.  Member-leading outputs: transpose around the call (cheap — XLA
+    layout assignment)."""
+    n = Xq.shape[0]
+    chunk = max(1024, _PREDICT_FUSED_MAX_CELLS // max(n_members * leaves, 1))
+    if n <= chunk:
+        return fn(Xq)
+    nc = -(-n // chunk)
+    pad = nc * chunk - n
+    Xp = jnp.pad(Xq, ((0, pad), (0, 0))).reshape(nc, chunk, Xq.shape[1])
+    out = jax.lax.map(fn, Xp)  # sequential: bounded live memory
+    return out.reshape((nc * chunk,) + out.shape[2:])[:n]
+
 
 def _fit_forest_streamed(
     Xb, Y, w, thresholds, feature_mask, *, max_depth, max_bins,
@@ -602,8 +633,8 @@ def _fit_forest_streamed(
     points as the dense path (histograms are psum-ed AFTER the scan, so the
     mesh contract stays O(nodes·bins·k) per level; the reference's
     treeAggregate analogue, `GBMClassifier.scala:413-431`).  Prefix sums
-    run as exact cumsums (`_prefix_sums` keys its tri-matmul fast path on
-    the dense tier).
+    take the same tier policy as the dense path (`_prefix_sums`): exact
+    cumsums at 'highest', the triangular matmul on the fast tiers.
 
     Routing identity: level-L routing is deferred into the level-(L+1)
     scan body (and the leaf scan) — the same einsum contractions at the
@@ -629,6 +660,12 @@ def _fit_forest_streamed(
     chunk = min(_STREAM_CHUNK_ROWS, n)
     nc = -(-n // chunk)
     pad = nc * chunk - n
+    # the scan re-reads the binned features once per level: store them at
+    # uint8 when the bin count allows (4x less HBM traffic on the tier's
+    # dominant read; bin ids 0..B-1 <= 255 are exact) and the one-hot /
+    # routing casts upcast per chunk
+    if B <= 256:
+        Xb = Xb.astype(jnp.uint8)
     # zero-weight padding: all-zero ``vals`` rows contribute exactly 0.0
     # to every histogram/leaf statistic; where they route is irrelevant
     Xb_c = jnp.pad(Xb, ((0, pad), (0, 0))).reshape(nc, chunk, d)
@@ -1197,14 +1234,22 @@ def predict_forest(
         )
     if not fused or depth > _MATMUL_PREDICT_MAX_DEPTH:
         return jax.vmap(lambda t: predict_tree(t, X))(trees)
-    leaf_oh = leaf_one_hot_forest(trees, X, binned=False)  # [n, M, L]
-    # exact one-hot side single-term; value side HIGHEST (bit-exact)
-    out = jnp.einsum(
-        "nml,mlk->nmk",
-        leaf_oh,
-        trees.leaf_value,
-        precision=(jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST),
-    )
+
+    def rows(Xc):
+        leaf_oh = leaf_one_hot_forest(trees, Xc, binned=False)  # [c, M, L]
+        # exact one-hot side single-term; value side HIGHEST (bit-exact)
+        return jnp.einsum(
+            "nml,mlk->nmk",
+            leaf_oh,
+            trees.leaf_value,
+            precision=(jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST),
+        )
+
+    # HBM-scale inference: past the routing one-hot's budget, lax.map the
+    # same program over row chunks so [rows, M, leaves] never materializes
+    # at full n (GBM model predicts ALSO reduce members inside their own
+    # predict_chunked_rows call; this guard covers every other caller)
+    out = predict_chunked_rows(rows, X, M, 2**depth)
     return jnp.moveaxis(out, 1, 0)  # [M, n, k]
 
 

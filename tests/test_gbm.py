@@ -307,3 +307,35 @@ def test_validation_history_recorded(cpusmall):
         m2.validation_history_
     # prefix models carry the aligned prefix of the curve
     np.testing.assert_allclose(m.take(2).validation_history_, hist[:2])
+
+
+def test_predict_row_chunking_matches_direct(monkeypatch):
+    """HBM-scale inference: past _PREDICT_CHUNK_CELLS the model predicts
+    via lax.map over row chunks (models/gbm.py _predict_chunked_rows) —
+    pinning a tiny budget must not change a single prediction, incl. a
+    non-divisible row count (padding)."""
+    import spark_ensemble_tpu.ops.tree as T
+
+    rng = np.random.RandomState(31)
+    # > the 1024-row chunk floor AND not a multiple of it, so the chunked
+    # branch (lax.map + padding) genuinely executes under the tiny budget
+    n = 2500
+    X = rng.randn(n, 6).astype(np.float32)
+    yc = (X[:, 0] + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    yr = (X @ rng.randn(6) + 0.1 * rng.randn(n)).astype(np.float32)
+
+    cm = se.GBMClassifier(num_base_learners=3, seed=0).fit(X, yc)
+    rm = se.GBMRegressor(num_base_learners=3, seed=0).fit(X, yr)
+    raw_direct = np.asarray(cm.predict_raw(X))
+    reg_direct = np.asarray(rm.predict(X))
+
+    monkeypatch.setattr(T, "_PREDICT_FUSED_MAX_CELLS", 64 * 1024)
+    # drop the cached direct-path jits so the tiny budget is retraced
+    object.__setattr__(cm, "_jit_cache", {})
+    object.__setattr__(rm, "_jit_cache", {})
+    np.testing.assert_allclose(
+        np.asarray(cm.predict_raw(X)), raw_direct, rtol=1e-6, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(rm.predict(X)), reg_direct, rtol=1e-6, atol=1e-6
+    )

@@ -919,3 +919,30 @@ def test_stream_param_validated_and_plumbed():
             base_learner=se.DecisionTreeRegressor(hist="stream"), **cfg
         ).fit(X, yc).predict(X)) == yc))
     assert abs(a_ref - a_st) < 0.02, (a_ref, a_st)
+
+
+def test_predict_forest_row_chunking_matches_direct(monkeypatch):
+    """predict_forest lax.maps over row chunks past its one-hot budget
+    (every non-GBM ensemble predict rides this); a tiny budget must not
+    change a single output, incl. padding (non-divisible n)."""
+    import spark_ensemble_tpu.ops.tree as T
+
+    rng = np.random.RandomState(33)
+    n, d, M = 2500, 5, 3
+    X = jnp.asarray(rng.randn(n, d).astype(np.float32))
+    b = compute_bins(X, 16)
+    Xb = bin_features(X, b)
+    Y = jnp.asarray(rng.randn(n, M, 1).astype(np.float32))
+    w = jnp.ones((n, M))
+    f = T.fit_forest(Xb, Y, w, b.thresholds, max_depth=4, max_bins=16,
+                     hist="matmul")
+    direct = T.predict_forest(f, X, fused=True)  # budget not yet patched
+    monkeypatch.setattr(T, "_PREDICT_FUSED_MAX_CELLS", 64 * 1024)
+    # chunk = max(1024, 65536 // (3 * 16)) = 1365 < 2500 -> chunked path
+    chunked = T.predict_forest(f, X, fused=True)
+    np.testing.assert_array_equal(np.asarray(direct), np.asarray(chunked))
+    # parity against the unchunked reference walk
+    ref = jax.vmap(lambda t: T.predict_tree(t, X))(f)
+    np.testing.assert_allclose(
+        np.asarray(chunked), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
