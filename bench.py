@@ -477,6 +477,19 @@ def _bench_xl_extras():
             out["xl_mfu_est"] = round(
                 flops * (rounds / fit_s) / _peak_flops(platform), 5
             )
+            # the 3-pass tier cuts the stream matmuls' MXU passes in half;
+            # capture the comparison in the same perishable window
+            try:
+                h_est = est.copy(
+                    base_learner=est.base_learner.copy(
+                        hist_precision="high"
+                    )
+                )
+                h_est.fit(X, y)  # warmup/compile
+                _, h_fit_s = _timed_fit(h_est, X, y)
+                out["xl_high_iters_per_sec"] = round(rounds / h_fit_s, 3)
+            except Exception as e:  # noqa: BLE001 - carry, keep going
+                out["xl_high_error"] = str(e)[:200]
         return out
     except Exception as e:  # noqa: BLE001 - carry the error, keep going
         return {"xl_error": str(e)[:200]}
