@@ -142,6 +142,25 @@ class _GBMParams(CheckpointableParams, Estimator):
         doc="per-round row subsample (stochastic gradient boosting); "
         "enters as Poisson/Bernoulli weights, not row subsets",
     )
+    sample_method = Param(
+        "uniform", in_array(["uniform", "goss"]),
+        doc="'goss' = gradient-based one-side sampling (fast-sampling GTB "
+        "family, arXiv:1911.08820; extension — the reference has only "
+        "uniform subbagging): each round keeps the top_rate fraction of "
+        "rows by gradient magnitude plus an amplified other_rate sample "
+        "of the rest; composes with subsample_ratio as a weight product",
+    )
+    top_rate = Param(
+        0.2, in_range(0.0, 1.0),
+        doc="GOSS: fraction of rows kept deterministically by gradient "
+        "magnitude",
+    )
+    other_rate = Param(
+        0.1, in_range(0.0, 1.0, lower_inclusive=False),
+        doc="GOSS: fraction of the FULL dataset sampled from the "
+        "small-gradient rest (kept rows amplified by the reciprocal "
+        "keep-rate, so the rest's gradient mass is unbiased)",
+    )
     replacement = Param(
         False, doc="subsample with replacement (Poisson weights)"
     )
@@ -153,7 +172,6 @@ class _GBMParams(CheckpointableParams, Estimator):
     max_iter = Param(
         100, gt_eq(1), doc="line-search iteration cap per round"
     )
-    tol = Param(1e-6, gt_eq(0.0), doc="line-search convergence tolerance")
     tol = Param(1e-6, gt_eq(0.0), doc="line-search convergence tolerance")
     num_rounds = Param(
         1, gt_eq(1),
@@ -319,14 +337,56 @@ class _GBMParams(CheckpointableParams, Estimator):
         return i, v, best
 
 
+def _goss_multiplier(
+    neg_grad, w, bag_w, key, top_rate, other_rate, axis_name
+):
+    """Gradient-based one-side sampling multiplier (the fast-sampling GTB
+    family, arXiv:1911.08820 / LightGBM's GOSS; an extension — the
+    reference has only uniform subbagging): keep every row in the
+    top ``top_rate`` fraction by gradient magnitude, keep a Bernoulli
+    sample of the rest sized ``other_rate`` of the FULL data and amplified
+    by the reciprocal keep-rate so the small-gradient mass stays unbiased.
+    Enters as a WEIGHT multiplier (static shapes — the framework's
+    sampling-by-weights discipline); the magnitude threshold is the exact
+    mesh-aware weighted quantile, so no device gathers the column."""
+    score = jnp.sqrt(jnp.sum(neg_grad * neg_grad, axis=-1))  # [n]
+    thr = weighted_quantile(
+        score, 1.0 - top_rate, w * bag_w, axis_name=axis_name
+    )
+    if axis_name is not None:
+        # decorrelate the Bernoulli draws across row shards (the same key
+        # on every shard would repeat the pattern shard-to-shard)
+        names = (
+            (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+        )
+        for nm in names:
+            key = jax.random.fold_in(key, jax.lax.axis_index(nm))
+    # other_rate is a fraction of the FULL dataset (LightGBM semantics):
+    # the keep-rate among the (1-top_rate) rest is other_rate/(1-top_rate)
+    # and the amplifier is its reciprocal, so E[multiplier | rest] = 1 —
+    # the small-gradient mass is unbiased (clipped when other_rate
+    # already covers the whole rest)
+    p = jnp.minimum(1.0, other_rate / jnp.maximum(1.0 - top_rate, 1e-9))
+    keep = jax.random.bernoulli(key, p, score.shape)
+    return jnp.where(score >= thr, 1.0, jnp.where(keep, 1.0 / p, 0.0))
+
+
 def _pseudo_residuals_and_weights(
-    loss, updates, y_enc, pred, bag_w, w, axis_name=None
+    loss, updates, y_enc, pred, bag_w, w, axis_name=None, goss=None,
+    goss_key=None,
 ):
     """Targets/weights for the round's base fit (`GBMRegressor.scala:368-385`,
-    `GBMClassifier.scala:337-375`).  Returns (labels[n, dim], fit_w[n, dim]).
+    `GBMClassifier.scala:337-375`).  Returns (labels[n, dim], fit_w[n, dim],
+    bag_w) — ``bag_w`` comes back multiplied by the GOSS sampling weights
+    when ``goss=(top_rate, other_rate)`` is set, so the round's line search
+    and leaf statistics see the same sampled set the trees fit.
     With ``axis_name`` the hessian sum reduces across data shards (the
     reference's element-wise treeReduce, `GBMClassifier.scala:344-355`)."""
     neg_grad = loss.negative_gradient(y_enc, pred)
+    if goss is not None:
+        bag_w = bag_w * _goss_multiplier(
+            neg_grad, w, bag_w, goss_key, goss[0], goss[1], axis_name
+        )
     if updates == "newton" and loss.has_hessian:
         h = jnp.maximum(loss.hessian(y_enc, pred), 1e-2)
         sum_h = jnp.sum(bag_w[:, None] * h, axis=0, keepdims=True)
@@ -337,7 +397,7 @@ def _pseudo_residuals_and_weights(
     else:
         labels = neg_grad
         fit_w = jnp.broadcast_to((w * bag_w)[:, None], neg_grad.shape)
-    return labels, fit_w
+    return labels, fit_w, bag_w
 
 
 class GBMRegressor(_GBMParams):
@@ -450,6 +510,11 @@ class GBMRegressor(_GBMParams):
         updates = self.updates.lower()
         optimized = bool(self.optimized_weights)
         lr = float(self.learning_rate)
+        goss = (
+            (float(self.top_rate), float(self.other_rate))
+            if self.sample_method.lower() == "goss"
+            else None
+        )
         sub_ratio = float(self.subsample_ratio)
         repl = bool(self.replacement)
         tol = float(self.tol)
@@ -476,8 +541,10 @@ class GBMRegressor(_GBMParams):
             def round_core(ctx, X, bag_w, key, mask, pred, delta, y, w):
                 loss = make_loss(delta)
                 y_enc = loss.encode_label(y)
-                labels, fit_w = _pseudo_residuals_and_weights(
-                    loss, updates, y_enc, pred[:, None], bag_w, w, axis_name=ax
+                labels, fit_w, bag_w = _pseudo_residuals_and_weights(
+                    loss, updates, y_enc, pred[:, None], bag_w, w,
+                    axis_name=ax, goss=goss,
+                    goss_key=jax.random.fold_in(key, 7),
                 )
                 params = base.fit_from_ctx(
                     ctx, labels[:, 0], fit_w[:, 0], mask, key, axis_name=ax
@@ -640,6 +707,7 @@ class GBMRegressor(_GBMParams):
             updates,
             optimized,
             lr,
+            goss,
             sub_ratio,
             repl,
             tol,
@@ -950,6 +1018,11 @@ class GBMClassifier(_GBMParams):
         updates = self.updates.lower()
         optimized = bool(self.optimized_weights)
         lr = float(self.learning_rate)
+        goss = (
+            (float(self.top_rate), float(self.other_rate))
+            if self.sample_method.lower() == "goss"
+            else None
+        )
         sub_ratio = float(self.subsample_ratio)
         repl = bool(self.replacement)
         tol = float(self.tol)
@@ -980,8 +1053,9 @@ class GBMClassifier(_GBMParams):
             k_local = dim_blk // member_size
 
             def round_core(ctx, X, y_enc, w, bag_w, key, mask, pred, alpha_ws):
-                labels, fit_w = _pseudo_residuals_and_weights(
-                    loss, updates, y_enc, pred, bag_w, w, axis_name=ax
+                labels, fit_w, bag_w = _pseudo_residuals_and_weights(
+                    loss, updates, y_enc, pred, bag_w, w, axis_name=ax,
+                    goss=goss, goss_key=jax.random.fold_in(key, 7),
                 )
                 if member_size > 1:
                     # each member shard fits its block of class dims — the
@@ -1169,6 +1243,7 @@ class GBMClassifier(_GBMParams):
             updates,
             optimized,
             lr,
+            goss,
             sub_ratio,
             repl,
             tol,
