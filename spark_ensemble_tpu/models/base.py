@@ -501,6 +501,32 @@ class BaseLearner(Estimator):
             in_axes=(1, 1, mask_axis, 0),
         )(ys, ws, feature_masks, keys)
 
+    def fit_and_direction(
+        self, ctx, y, w, feature_mask, key, X, axis_name=None
+    ):
+        """Member fit PLUS the fitted member's predictions on the SAME rows
+        (the GBM round's ``direction``) -> (params, pred[n]).
+
+        Default: fit then predict.  Learners whose fit already routes every
+        row to its output region override this to REUSE that routing
+        instead of re-walking the model (trees return the leaf ids their
+        fit computed — the per-round predict re-route disappears)."""
+        params = self.fit_from_ctx(
+            ctx, y, w, feature_mask, key, axis_name=axis_name
+        )
+        return params, self.predict_fn(params, X)
+
+    def fit_many_and_directions(
+        self, ctx, ys, ws, feature_masks, keys, X, axis_name=None
+    ):
+        """Fused-member analogue of ``fit_and_direction`` ->
+        (stacked params, preds[n, M])."""
+        params = self.fit_many_from_ctx(
+            ctx, ys, ws, feature_masks, keys, axis_name=axis_name
+        )
+        preds = jax.vmap(lambda p: self.predict_fn(p, X))(params)
+        return params, preds.T
+
     def ctx_specs(self, ctx: Any, data_axis: str):
         """``shard_map`` PartitionSpecs for the fit ctx under row sharding:
         row-indexed leaves sharded over ``data_axis``, the rest replicated.

@@ -546,10 +546,13 @@ class GBMRegressor(_GBMParams):
                     axis_name=ax, goss=goss,
                     goss_key=jax.random.fold_in(key, 7),
                 )
-                params = base.fit_from_ctx(
-                    ctx, labels[:, 0], fit_w[:, 0], mask, key, axis_name=ax
+                # fit + same-row predictions in one protocol call: tree
+                # learners reuse the leaf ids their fit computed instead
+                # of re-routing every row (models/tree.py)
+                params, direction = base.fit_and_direction(
+                    ctx, labels[:, 0], fit_w[:, 0], mask, key, X,
+                    axis_name=ax,
                 )
-                direction = base.predict_fn(params, X)
                 if optimized and loss_name == "squared":
                     # phi(a) = sum bw*(res - a*dir)^2/2 is EXACTLY quadratic:
                     # the minimizer is one data pass, not ~max_iter
@@ -1077,10 +1080,11 @@ class GBMClassifier(_GBMParams):
                 # one fused multi-member fit replaces the reference's
                 # per-dim Futures (trees: the class dims fold into a single
                 # histogram matmul per level — ops/tree.py fit_forest)
-                params = base.fit_many_from_ctx(
-                    ctx, labels_blk, fitw_blk, mask, key, axis_name=ax
+                # fused fit + same-row predictions (leaf-id reuse for
+                # trees — the per-round forest predict re-route disappears)
+                params, directions = base.fit_many_and_directions(
+                    ctx, labels_blk, fitw_blk, mask, key, X, axis_name=ax
                 )
-                directions = jax.vmap(lambda p: base.predict_fn(p, X))(params).T
                 if member_size > 1:
                     directions = jax.lax.all_gather(
                         directions, "member", axis=1, tiled=True

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from spark_ensemble_tpu.models.base import (
+    BaseLearner,
     RegressionModel,
     as_f32,
 )
@@ -169,6 +170,12 @@ class LinearTreeRegressor(DecisionTreeRegressor):
             ctx, y, w, feature_mask, key, axis_name=axis_name
         )
         return self._leaf_models(ctx, tree, y, w, feature_mask, axis_name)
+
+    # the _TreeLearner leaf-reuse shortcuts return a bare Tree with
+    # CONSTANT-leaf directions — wrong params type and wrong predictions
+    # for linear leaves; keep the generic fit-then-predict compose
+    fit_and_direction = BaseLearner.fit_and_direction
+    fit_many_and_directions = BaseLearner.fit_many_and_directions
 
     def fit_many_from_ctx(self, ctx, ys, ws, feature_masks, keys, axis_name=None):
         """Member fits keep the FUSED forest histogram build (one matmul per

@@ -82,7 +82,8 @@ class _TreeLearner(BaseLearner):
     def _targets(self, ctx, y) -> jax.Array:
         raise NotImplementedError
 
-    def fit_from_ctx(self, ctx, y, w, feature_mask, key, axis_name=None):
+    def fit_from_ctx(self, ctx, y, w, feature_mask, key, axis_name=None,
+                     return_leaf=False):
         return fit_tree(
             ctx["Xb"],
             self._targets(ctx, y),
@@ -95,13 +96,15 @@ class _TreeLearner(BaseLearner):
             axis_name=axis_name,
             hist=self.hist,
             hist_precision=self.hist_precision,
+            return_leaf=return_leaf,
         )
 
     def _targets_many(self, ctx, ys) -> jax.Array:
         """[n, M] member target columns -> [n, M, k] tree targets."""
         raise NotImplementedError
 
-    def fit_many_from_ctx(self, ctx, ys, ws, feature_masks, keys, axis_name=None):
+    def fit_many_from_ctx(self, ctx, ys, ws, feature_masks, keys,
+                          axis_name=None, return_leaf=False):
         """All members in ONE fused forest fit: the member axis folds into
         the histogram matmul's M dim (``ops.tree.fit_forest``) instead of a
         vmap that re-streams the shared bin-one-hot per member."""
@@ -117,7 +120,49 @@ class _TreeLearner(BaseLearner):
             axis_name=axis_name,
             hist=self.hist,
             hist_precision=self.hist_precision,
+            return_leaf=return_leaf,
         )
+
+    def fit_and_direction(self, ctx, y, w, feature_mask, key, X,
+                          axis_name=None):
+        """The tree fit already routed every row to its leaf: contract the
+        returned leaf ids against the leaf values instead of re-walking
+        the tree (bit-identical — binned and raw routing agree,
+        `test_binned_and_raw_predict_agree`; exact one-hot selection)."""
+        tree, node = self.fit_from_ctx(
+            ctx, y, w, feature_mask, key, axis_name=axis_name,
+            return_leaf=True,
+        )
+        oh = jax.nn.one_hot(
+            node, tree.leaf_value.shape[0], dtype=jnp.float32
+        )
+        pred = jax.lax.dot_general(
+            oh, tree.leaf_value, (((1,), (0,)), ((), ())),
+            precision=(jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST),
+        )  # [n, k]
+        return tree, self._direction_from_leaf(pred)
+
+    def fit_many_and_directions(self, ctx, ys, ws, feature_masks, keys, X,
+                                axis_name=None):
+        """Fused-member fit with leaf-id reuse (see ``fit_and_direction``):
+        one [n, M, leaves] one-hot contraction replaces the per-round
+        forest predict re-route."""
+        trees, node = self.fit_many_from_ctx(
+            ctx, ys, ws, feature_masks, keys, axis_name=axis_name,
+            return_leaf=True,
+        )
+        oh = jax.nn.one_hot(
+            node, trees.leaf_value.shape[1], dtype=jnp.float32
+        )  # [n, M, L]
+        preds = jnp.einsum(
+            "nml,mlk->nmk", oh, trees.leaf_value,
+            precision=(jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST),
+        )
+        return trees, self._direction_from_leaf(preds)
+
+    def _direction_from_leaf(self, pred):
+        """Leaf-value selection -> the member's scalar prediction."""
+        raise NotImplementedError
 
     def ctx_specs(self, ctx, data_axis):
         from jax.sharding import PartitionSpec as P
@@ -134,6 +179,9 @@ class _TreeLearner(BaseLearner):
 
 class DecisionTreeRegressor(_TreeLearner):
     is_classifier = False
+
+    def _direction_from_leaf(self, pred):
+        return pred[..., 0]
 
     def _targets(self, ctx, y):
         return y[:, None]
@@ -160,6 +208,10 @@ class DecisionTreeRegressionModel(RegressionModel, DecisionTreeRegressor):
 
 class DecisionTreeClassifier(_TreeLearner):
     is_classifier = True
+
+    def _direction_from_leaf(self, pred):
+        # parity with predict_fn: argmax over the leaf class distribution
+        return jnp.argmax(pred, axis=-1).astype(jnp.float32)
 
     def _targets(self, ctx, y):
         return jax.nn.one_hot(y.astype(jnp.int32), static_value(ctx["num_classes"]))

@@ -270,7 +270,7 @@ def _resolve_hist(hist: str, n: int, d: int, B: int) -> str:
     jax.jit,
     static_argnames=(
         "max_depth", "max_bins", "min_info_gain", "axis_name", "hist",
-        "hist_precision",
+        "hist_precision", "return_leaf",
     ),
 )
 def fit_tree(
@@ -286,6 +286,7 @@ def fit_tree(
     axis_name: Optional[str] = None,
     hist: str = "auto",  # auto | scatter | matmul | stream
     hist_precision: str = "highest",  # statistic-matmul MXU passes, see below
+    return_leaf: bool = False,  # also return each row's final leaf id [n]
 ) -> Tree:
     """``hist_precision`` sets the MXU precision of the STATISTIC math
     (histogram accumulation, leaf sums, and — on the fast tiers — the bin
@@ -320,7 +321,11 @@ def fit_tree(
             axis_name=axis_name,
             hist="stream",
             hist_precision=hist_precision,
+            return_leaf=return_leaf,
         )
+        if return_leaf:
+            forest, node = forest
+            return jax.tree_util.tree_map(lambda a: a[0], forest), node[:, 0]
         return jax.tree_util.tree_map(lambda a: a[0], forest)
     # case-normalized here (not at the Param) so direct kernel callers get
     # the same tolerance as estimator users
@@ -551,13 +556,18 @@ def fit_tree(
         )
     leaf_value = leaf_wy / jnp.maximum(leaf_w[:, None], 1e-30)
     leaf_value = jnp.where(leaf_w[:, None] > 1e-12, leaf_value, parent_value)
-    return Tree(
+    tree = Tree(
         split_feature=split_feature,
         split_bin=split_bin,
         split_threshold=split_threshold,
         leaf_value=leaf_value + y_mean[None, :],
         split_gain=split_gain,
     )
+    # the loop's final `node` IS each row's leaf id — callers fitting then
+    # immediately predicting on the SAME rows (the GBM round) reuse it
+    # instead of re-routing (bit-identical: binned and raw routing agree,
+    # test_binned_and_raw_predict_agree)
+    return (tree, node) if return_leaf else tree
 
 
 def feature_gains(trees: Tree, d: int) -> jax.Array:
@@ -617,7 +627,7 @@ def predict_chunked_rows(fn, Xq, n_members, leaves):
 
 def _fit_forest_streamed(
     Xb, Y, w, thresholds, feature_mask, *, max_depth, max_bins,
-    min_info_gain, axis_name, stat_prec, route_prec,
+    min_info_gain, axis_name, stat_prec, route_prec, return_leaf=False,
 ):
     """Row-chunked fused-forest fit (``hist="stream"``): the HBM-scale tier.
 
@@ -747,9 +757,9 @@ def _fit_forest_streamed(
             "nml,nmc->mlc", leaf_oh, vl,
             precision=_stat_precision_vs_onehot(stat_prec)[::-1],
         )
-        return acc, None
+        return acc, nd
 
-    L, _ = jax.lax.scan(
+    L, node_c = jax.lax.scan(
         leaf_body,
         _pvary(jnp.zeros((M, num_leaves, C), jnp.float32)),
         (Xb_c, node_c, vals_c),
@@ -760,20 +770,23 @@ def _fit_forest_streamed(
     leaf_value = jnp.where(
         leaf_w[:, :, None] > 1e-12, leaf_value, parent_value
     )
-    return Tree(
+    tree = Tree(
         split_feature=split_feature,
         split_bin=split_bin,
         split_threshold=split_threshold,
         leaf_value=leaf_value + y_mean[:, None, :],
         split_gain=split_gain,
     )
+    if return_leaf:
+        return tree, node_c.reshape(nc * chunk, M)[:n]
+    return tree
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
         "max_depth", "max_bins", "min_info_gain", "axis_name", "hist",
-        "hist_precision",
+        "hist_precision", "return_leaf",
     ),
 )
 def fit_forest(
@@ -789,6 +802,7 @@ def fit_forest(
     axis_name: Optional[str] = None,
     hist: str = "auto",
     hist_precision: str = "highest",  # see fit_tree
+    return_leaf: bool = False,  # also return row leaf ids [n, M]
 ) -> Tree:
     """Fit M trees at once on shared binned features -> stacked ``Tree``
     (leading member axis, same structure as ``jax.vmap(fit_tree)``).
@@ -874,6 +888,7 @@ def fit_forest(
             max_depth=max_depth, max_bins=max_bins,
             min_info_gain=min_info_gain, axis_name=axis_name,
             stat_prec=stat_prec, route_prec=route_prec,
+            return_leaf=return_leaf,
         )
 
     # budget the fused path by its LARGEST [n, M, ...] intermediate: the
@@ -899,8 +914,13 @@ def fit_forest(
             axis_name=axis_name,
             hist=hist,
             hist_precision=hist_precision,
+            return_leaf=return_leaf,
         )
-        return jax.vmap(fit_one, in_axes=(1, 1, 0))(Y, w, feature_mask)
+        out = jax.vmap(fit_one, in_axes=(1, 1, 0))(Y, w, feature_mask)
+        if return_leaf:
+            trees, nodes = out
+            return trees, nodes.T  # [n, M]
+        return out
 
     preduce = lambda x: _preduce(x, axis_name)
 
@@ -1028,13 +1048,16 @@ def fit_forest(
     leaf_wy = preduce(L[:, :, 1:])  # [M, L, k]
     leaf_value = leaf_wy / jnp.maximum(leaf_w[:, :, None], 1e-30)
     leaf_value = jnp.where(leaf_w[:, :, None] > 1e-12, leaf_value, parent_value)
-    return Tree(
+    tree = Tree(
         split_feature=split_feature,
         split_bin=split_bin,
         split_threshold=split_threshold,
         leaf_value=leaf_value + y_mean[:, None, :],
         split_gain=split_gain,
     )
+    # see fit_tree: `node` is each row's final leaf id, reusable by
+    # fit-then-predict-same-rows callers (the GBM round)
+    return (tree, node) if return_leaf else tree
 
 
 @functools.lru_cache(maxsize=None)

@@ -946,3 +946,40 @@ def test_predict_forest_row_chunking_matches_direct(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(chunked), np.asarray(ref), rtol=1e-5, atol=1e-5
     )
+
+
+@pytest.mark.parametrize("hist", ["scatter", "matmul", "stream"])
+def test_fit_and_direction_matches_predict(hist, monkeypatch):
+    """The leaf-id-reuse direction (fit_and_direction /
+    fit_many_and_directions) must be BIT-identical to predicting with the
+    fitted tree — the invariant the GBM round's re-route elimination
+    rests on.  Parametrized over every histogram backend: each has its
+    own return_leaf plumbing (loop-final node / vmap transpose / stream
+    scan reshape)."""
+    import spark_ensemble_tpu as se
+    import spark_ensemble_tpu.ops.tree as T
+
+    monkeypatch.setattr(T, "_STREAM_CHUNK_ROWS", 512)  # multi-chunk + pad
+    rng = np.random.RandomState(51)
+    n, d, M = 1500, 6, 3
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X @ rng.randn(d) + 0.1 * rng.randn(n)).astype(np.float32)
+    est = se.DecisionTreeRegressor(max_depth=4, hist=hist)
+    ctx = est.make_fit_ctx(jnp.asarray(X))
+    w = jnp.ones((n,))
+    key = jax.random.PRNGKey(0)
+    params, direction = est.fit_and_direction(
+        ctx, jnp.asarray(y), w, None, key, jnp.asarray(X)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(direction), np.asarray(est.predict_fn(params, jnp.asarray(X)))
+    )
+    # fused-member version
+    ys = jnp.asarray(np.stack([y, -y, y * 0.5], axis=1))
+    ws = jnp.ones((n, M))
+    keys = jax.random.split(key, M)
+    trees, dirs = est.fit_many_and_directions(
+        ctx, ys, ws, None, keys, jnp.asarray(X)
+    )
+    ref = jax.vmap(lambda p: est.predict_fn(p, jnp.asarray(X)))(trees).T
+    np.testing.assert_array_equal(np.asarray(dirs), np.asarray(ref))
