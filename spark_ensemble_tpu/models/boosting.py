@@ -263,10 +263,12 @@ class BoostingClassifier(_BoostingParams):
 
             def round_discrete(ctx, X, y, bw, key):
                 w_norm = bw / jnp.maximum(gsum(jnp.sum(bw)), 1e-30)
-                params = base.fit_from_ctx(
-                    ctx, y, w_norm, None, key, axis_name=ax
+                # fit + same-row class predictions in one call (tree
+                # learners reuse fit-time leaf routing, models/tree.py)
+                params, pred = base.fit_and_direction(
+                    ctx, y, w_norm, None, key, X, axis_name=ax
                 )
-                miss = (base.predict_fn(params, X) != y).astype(jnp.float32)
+                miss = (pred != y).astype(jnp.float32)
                 err = gsum(jnp.sum(w_norm * miss))
                 beta = err / jnp.maximum((1.0 - err) * (k - 1.0), 1e-30)
                 est_weight = jnp.where(
@@ -519,12 +521,13 @@ class BoostingRegressor(_BoostingParams):
 
             def step(ctx, X, y, valid, bw, key):
                 w_norm = bw / jnp.maximum(gsum(jnp.sum(bw)), 1e-30)
-                params = base.fit_from_ctx(
-                    ctx, y, w_norm, None, key, axis_name=ax
+                # fit + same-row predictions in one call (leaf-id reuse)
+                params, pred = base.fit_and_direction(
+                    ctx, y, w_norm, None, key, X, axis_name=ax
                 )
                 # mask padding rows out of the max: their |y - pred| is
                 # meaningless (y padded with 0) and must not set maxError
-                errors = valid * jnp.abs(y - base.predict_fn(params, X))
+                errors = valid * jnp.abs(y - pred)
                 max_error = gmax(jnp.max(errors))
                 rel = jnp.where(
                     max_error > 0, errors / jnp.maximum(max_error, 1e-30), errors

@@ -28,6 +28,7 @@ from spark_ensemble_tpu.ops.tree import (
     feature_gains,
     fit_forest,
     fit_tree,
+    predict_chunked_rows,
     predict_forest,
     predict_tree,
 )
@@ -133,13 +134,18 @@ class _TreeLearner(BaseLearner):
             ctx, y, w, feature_mask, key, axis_name=axis_name,
             return_leaf=True,
         )
-        oh = jax.nn.one_hot(
-            node, tree.leaf_value.shape[0], dtype=jnp.float32
-        )
-        pred = jax.lax.dot_general(
-            oh, tree.leaf_value, (((1,), (0,)), ((), ())),
-            precision=(jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST),
-        )  # [n, k]
+        L = tree.leaf_value.shape[0]
+
+        def rows(nd):  # row-chunked past the one-hot budget (HBM scale)
+            oh = jax.nn.one_hot(nd[:, 0], L, dtype=jnp.float32)
+            return jax.lax.dot_general(
+                oh, tree.leaf_value, (((1,), (0,)), ((), ())),
+                precision=(
+                    jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST
+                ),
+            )  # [c, k]
+
+        pred = predict_chunked_rows(rows, node[:, None], 1, L)
         return tree, self._direction_from_leaf(pred)
 
     def fit_many_and_directions(self, ctx, ys, ws, feature_masks, keys, X,
@@ -151,13 +157,18 @@ class _TreeLearner(BaseLearner):
             ctx, ys, ws, feature_masks, keys, axis_name=axis_name,
             return_leaf=True,
         )
-        oh = jax.nn.one_hot(
-            node, trees.leaf_value.shape[1], dtype=jnp.float32
-        )  # [n, M, L]
-        preds = jnp.einsum(
-            "nml,mlk->nmk", oh, trees.leaf_value,
-            precision=(jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST),
-        )
+        M, L = trees.leaf_value.shape[:2]
+
+        def rows(nd):  # row-chunked past the one-hot budget (HBM scale)
+            oh = jax.nn.one_hot(nd, L, dtype=jnp.float32)  # [c, M, L]
+            return jnp.einsum(
+                "nml,mlk->nmk", oh, trees.leaf_value,
+                precision=(
+                    jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST
+                ),
+            )
+
+        preds = predict_chunked_rows(rows, node, M, L)
         return trees, self._direction_from_leaf(preds)
 
     def _direction_from_leaf(self, pred):

@@ -983,3 +983,16 @@ def test_fit_and_direction_matches_predict(hist, monkeypatch):
     )
     ref = jax.vmap(lambda p: est.predict_fn(p, jnp.asarray(X)))(trees).T
     np.testing.assert_array_equal(np.asarray(dirs), np.asarray(ref))
+
+    # classifier: the argmax direction feeds boosting's discrete-round
+    # weight updates — must match predict_fn exactly too
+    yc = jnp.asarray((X[:, 0] > 0).astype(np.float32))
+    cest = se.DecisionTreeClassifier(max_depth=3, hist=hist)
+    cctx = cest.make_fit_ctx(jnp.asarray(X), num_classes=2)
+    cparams, cdir = cest.fit_and_direction(
+        cctx, yc, w, None, key, jnp.asarray(X)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(cdir),
+        np.asarray(cest.predict_fn(cparams, jnp.asarray(X))),
+    )
