@@ -516,6 +516,17 @@ class BaseLearner(Estimator):
         )
         return params, self.predict_fn(params, X)
 
+    def fit_and_proba(
+        self, ctx, y, w, feature_mask, key, X, axis_name=None
+    ):
+        """Classifier member fit PLUS class probabilities on the SAME rows
+        (SAMME.R's per-round input) -> (params, proba[n, k]).  Default:
+        fit then predict_proba; routing-reuse learners override."""
+        params = self.fit_from_ctx(
+            ctx, y, w, feature_mask, key, axis_name=axis_name
+        )
+        return params, self.predict_proba_fn(params, X)
+
     def fit_many_and_directions(
         self, ctx, ys, ws, feature_masks, keys, X, axis_name=None
     ):

@@ -279,10 +279,10 @@ class BoostingClassifier(_BoostingParams):
 
             def round_real(ctx, X, y, bw, key):
                 w_norm = bw / jnp.maximum(gsum(jnp.sum(bw)), 1e-30)
-                params = base.fit_from_ctx(
-                    ctx, y, w_norm, None, key, axis_name=ax
-                )
-                proba = base.predict_proba_fn(params, X)  # [n, k]
+                # fit + same-row probabilities in one call (leaf-id reuse)
+                params, proba = base.fit_and_proba(
+                    ctx, y, w_norm, None, key, X, axis_name=ax
+                )  # [n, k]
                 miss = (jnp.argmax(proba, axis=-1) != y.astype(jnp.int32)).astype(
                     jnp.float32
                 )

@@ -35,6 +35,14 @@ from spark_ensemble_tpu.ops.tree import (
 from spark_ensemble_tpu.params import Param, gt_eq, in_array, in_range
 
 
+def _renorm_proba(p):
+    """Leaf class distribution -> probability vector: clip tiny negative
+    fallback artifacts, renormalize.  ONE definition so predict_proba and
+    the routing-reuse fit_and_proba stay exactly in sync."""
+    p = jnp.maximum(p, 0.0)
+    return p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+
+
 class _TreeLearner(BaseLearner):
     max_depth = Param(
         5, in_range(1, 20),
@@ -124,12 +132,9 @@ class _TreeLearner(BaseLearner):
             return_leaf=return_leaf,
         )
 
-    def fit_and_direction(self, ctx, y, w, feature_mask, key, X,
-                          axis_name=None):
-        """The tree fit already routed every row to its leaf: contract the
-        returned leaf ids against the leaf values instead of re-walking
-        the tree (bit-identical — binned and raw routing agree,
-        `test_binned_and_raw_predict_agree`; exact one-hot selection)."""
+    def _fit_and_leaf_pred(self, ctx, y, w, feature_mask, key, axis_name):
+        """Fit + the selected leaf-value vector per row -> (tree,
+        pred[n, k]): the shared core of the routing-reuse methods."""
         tree, node = self.fit_from_ctx(
             ctx, y, w, feature_mask, key, axis_name=axis_name,
             return_leaf=True,
@@ -145,7 +150,17 @@ class _TreeLearner(BaseLearner):
                 ),
             )  # [c, k]
 
-        pred = predict_chunked_rows(rows, node[:, None], 1, L)
+        return tree, predict_chunked_rows(rows, node[:, None], 1, L)
+
+    def fit_and_direction(self, ctx, y, w, feature_mask, key, X,
+                          axis_name=None):
+        """The tree fit already routed every row to its leaf: contract the
+        returned leaf ids against the leaf values instead of re-walking
+        the tree (bit-identical — binned and raw routing agree,
+        `test_binned_and_raw_predict_agree`; exact one-hot selection)."""
+        tree, pred = self._fit_and_leaf_pred(
+            ctx, y, w, feature_mask, key, axis_name
+        )
         return tree, self._direction_from_leaf(pred)
 
     def fit_many_and_directions(self, ctx, ys, ws, feature_masks, keys, X,
@@ -224,6 +239,15 @@ class DecisionTreeClassifier(_TreeLearner):
         # parity with predict_fn: argmax over the leaf class distribution
         return jnp.argmax(pred, axis=-1).astype(jnp.float32)
 
+    def fit_and_proba(self, ctx, y, w, feature_mask, key, X,
+                      axis_name=None):
+        """Leaf-id reuse for SAMME.R: the selected leaf distribution,
+        renormalized exactly like ``predict_proba_fn``."""
+        tree, leaf_pred = self._fit_and_leaf_pred(
+            ctx, y, w, feature_mask, key, axis_name
+        )
+        return tree, _renorm_proba(leaf_pred)
+
     def _targets(self, ctx, y):
         return jax.nn.one_hot(y.astype(jnp.int32), static_value(ctx["num_classes"]))
 
@@ -235,15 +259,13 @@ class DecisionTreeClassifier(_TreeLearner):
     def predict_proba_fn(self, params: Tree, X):
         # leaf values are weighted one-hot means: a probability vector up to
         # zero-weight fallbacks; renormalize defensively
-        p = jnp.maximum(predict_tree(params, X), 0.0)
-        return p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+        return _renorm_proba(predict_tree(params, X))
 
     def predict_many_fn(self, params: Tree, X):
         return jnp.argmax(predict_forest(params, X), axis=-1).astype(jnp.float32)
 
     def predict_proba_many_fn(self, params: Tree, X):
-        p = jnp.maximum(predict_forest(params, X), 0.0)
-        return p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+        return _renorm_proba(predict_forest(params, X))
 
     def predict_raw_fn(self, params: Tree, X):
         return predict_tree(params, X)

@@ -996,3 +996,11 @@ def test_fit_and_direction_matches_predict(hist, monkeypatch):
         np.asarray(cdir),
         np.asarray(cest.predict_fn(cparams, jnp.asarray(X))),
     )
+    # probabilities (SAMME.R's input) must match predict_proba_fn exactly
+    pparams, proba = cest.fit_and_proba(
+        cctx, yc, w, None, key, jnp.asarray(X)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(proba),
+        np.asarray(cest.predict_proba_fn(pparams, jnp.asarray(X))),
+    )
