@@ -175,3 +175,55 @@ def test_loss_gradients_match_numerical(yi, fi):
         assert abs(grad - num) < 5e-2 + 1e-2 * abs(num), (
             type(loss).__name__, y, f, grad, num,
         )
+
+
+@given(
+    seed=st.integers(0, 2**16),
+    top_pct=st.integers(5, 60),
+    other_pct=st.integers(5, 60),
+)
+@settings(max_examples=20, deadline=None)
+def test_goss_multiplier_properties(seed, top_pct, other_pct):
+    """GOSS multiplier invariants (models/gbm.py _goss_multiplier): every
+    top-gradient row keeps weight exactly 1; rest rows are 0 or the
+    reciprocal keep-rate; the EXPECTED multiplier of every rest row is 1
+    (unbiased small-gradient mass), checked by averaging many draws."""
+    from spark_ensemble_tpu.models.gbm import _goss_multiplier
+
+    rng = np.random.RandomState(seed)
+    n = 400
+    top_rate, other_rate = top_pct / 100.0, other_pct / 100.0
+    g = jnp.asarray(rng.randn(n, 3).astype(np.float32))
+    w = jnp.ones((n,))
+    bag = jnp.ones((n,))
+    score = np.sqrt(np.sum(np.asarray(g) ** 2, axis=1))
+    # derive the threshold with the IMPLEMENTATION's own quantile: an
+    # independently computed numpy quantile can disagree at the boundary
+    # rank (f32 target rounding), flipping one row's top/rest side
+    from spark_ensemble_tpu.utils.quantile import weighted_quantile
+
+    thr = float(
+        weighted_quantile(jnp.asarray(score), 1.0 - top_rate, w * bag)
+    )
+
+    draws = np.stack([
+        np.asarray(
+            _goss_multiplier(
+                g, w, bag, jax.random.PRNGKey(i), top_rate, other_rate,
+                None,
+            )
+        )
+        for i in range(60)
+    ])
+    top = score >= thr
+    # top rows: always exactly 1
+    assert (draws[:, top] == 1.0).all()
+    rest = draws[:, ~top]
+    if rest.size:
+        p = min(1.0, other_rate / max(1.0 - top_rate, 1e-9))
+        vals = np.unique(rest)
+        assert np.all(
+            np.isclose(vals[:, None], [0.0, 1.0 / p]).any(axis=1)
+        ), vals
+        # unbiasedness: mean multiplier -> 1 (60 draws, generous tol)
+        np.testing.assert_allclose(rest.mean(), 1.0, atol=0.25)

@@ -1004,3 +1004,37 @@ def test_fit_and_direction_matches_predict(hist, monkeypatch):
         np.asarray(proba),
         np.asarray(cest.predict_proba_fn(pparams, jnp.asarray(X))),
     )
+
+
+def test_stream_tier_uint8_boundary_at_256_bins(monkeypatch):
+    """max_bins=256 is the uint8 storage boundary (bin ids 0..255): the
+    stream tier must stay exact there, and above it (max_bins=300) the
+    storage falls back to the wider dtype — both match the dense tier."""
+    import spark_ensemble_tpu.ops.tree as T
+
+    monkeypatch.setattr(T, "_STREAM_CHUNK_ROWS", 256)
+    rng = np.random.RandomState(61)
+    for B in (256, 300):
+        n, d, M = 700, 3, 2
+        X = jnp.asarray(rng.randn(n, d).astype(np.float32))
+        b = compute_bins(X, B)
+        Xb = bin_features(X, b)
+        # force occupancy of the HIGHEST bins incl. id B-1
+        assert int(jnp.max(Xb)) >= B - 2, int(jnp.max(Xb))
+        Y = jnp.asarray(rng.randn(n, M, 1).astype(np.float32))
+        w = jnp.ones((n, M))
+        kw = dict(max_depth=3, max_bins=B)
+        dense = T.fit_forest(Xb, Y, w, b.thresholds, hist="matmul", **kw)
+        stream = T.fit_forest(Xb, Y, w, b.thresholds, hist="stream", **kw)
+        np.testing.assert_array_equal(
+            np.asarray(dense.split_feature), np.asarray(stream.split_feature),
+            err_msg=f"B={B}",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(dense.split_bin), np.asarray(stream.split_bin),
+            err_msg=f"B={B}",
+        )
+        np.testing.assert_allclose(
+            np.asarray(dense.leaf_value), np.asarray(stream.leaf_value),
+            rtol=1e-4, atol=1e-5,
+        )
