@@ -27,6 +27,11 @@ _REPO = os.path.dirname(os.path.abspath(__file__))
 # the round count varies per path and lives in the "num_rounds" field.
 _METRIC = "GBM boosting-iters/sec/chip (letter)"
 
+# extras sections of the bench battery: main() arms them all on a green
+# accelerator probe, and inner() prints the salvage-partial headline line
+# whenever any is enabled — ONE tuple so the two gates cannot drift
+_BATTERY_KNOBS = ("BENCH_FULL", "BENCH_LARGE", "BENCH_TIERS", "BENCH_XL")
+
 # First driver-captured iters/sec per device platform (see BASELINE.md).
 # vs_baseline for later rounds = measured / baseline on the same platform.
 #
@@ -82,7 +87,13 @@ def _probe_accelerator(timeout_s):
 
 
 def _run_inner(env, timeout_s):
-    """Run the measured bench in a subprocess; return (json_dict | None, err)."""
+    """Run the measured bench in a subprocess; return (json_dict | None, err).
+
+    The inner process prints the HEADLINE json line as soon as it is
+    measured and the full line at the end; the LAST parseable line wins —
+    so a timeout mid-extras (a perishable accelerator window closing)
+    still salvages the headline from the partial stdout."""
+    err = None
     try:
         p = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--inner"],
@@ -92,14 +103,39 @@ def _run_inner(env, timeout_s):
             text=True,
             timeout=timeout_s,
         )
-    except subprocess.TimeoutExpired:
-        return None, f"bench run timed out after {timeout_s}s"
-    for line in reversed((p.stdout or "").strip().splitlines()):
+        stdout, stderr = p.stdout, p.stderr
+        if p.returncode != 0:
+            # a crash AFTER the partial print must not read as success
+            err = (
+                f"inner exited rc={p.returncode}: "
+                f"{(stderr or '').strip()[-300:]}"
+            )
+    except subprocess.TimeoutExpired as e:
+        err = f"bench run timed out after {timeout_s}s"
+        stdout = e.stdout or ""
+        stderr = e.stderr or ""
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode(errors="replace")
+    for line in reversed((stdout or "").strip().splitlines()):
         try:
-            return json.loads(line), None
+            result = json.loads(line)
+            if result.pop("partial", None) is not None:
+                # the salvage marker is consumed here: record what
+                # actually happened to the extras instead
+                cause = err or "inner stopped after the headline"
+                result["extras"] = "lost"
+                result["error"] = (
+                    f"extras lost ({cause}); headline salvaged from the "
+                    "partial line"
+                )
+            elif err:
+                result["error"] = err
+            return result, None
         except json.JSONDecodeError:
             continue
-    return None, (p.stderr or p.stdout).strip()[-800:] or "no output"
+    return None, err or (stderr or stdout).strip()[-800:] or "no output"
 
 
 def main():
@@ -149,9 +185,7 @@ def main():
         probed_platform = (info.splitlines() or [""])[-1].split(" ")[0]
         armed = probed_platform in ("tpu", "gpu", "cuda", "rocm")
         if armed:
-            for knob in (
-                "BENCH_FULL", "BENCH_LARGE", "BENCH_TIERS", "BENCH_XL"
-            ):
+            for knob in _BATTERY_KNOBS:
                 env.setdefault(knob, "1")
         did_arm = env != dict(os.environ)
         result, err = _run_inner(env, inner_timeout)
@@ -228,7 +262,12 @@ def _load_last_tpu_capture():
 
 def _finish(result, errors, warnings=None):
     if errors:
-        result["error"] = "; ".join(errors)[-1000:]
+        # append to (never clobber) an error the inner run already carries
+        # — e.g. the extras-lost note on a salvaged partial headline
+        prior = result.get("error")
+        result["error"] = "; ".join(
+            ([prior] if prior else []) + errors
+        )[-1000:]
     if warnings:
         result["warnings"] = "; ".join(warnings)[-1000:]
     platform = result.get("platform", "cpu")
@@ -571,6 +610,35 @@ def inner():
     train_acc = float(np.mean(np.asarray(model.predict(Xd)) == y))
 
     platform = jax.devices()[0].platform
+
+    # emit the HEADLINE result immediately (flushed): the parent takes the
+    # LAST parseable stdout line, so if a perishable accelerator window
+    # dies mid-extras the already-measured headline still lands instead of
+    # the whole run timing out empty
+    flops = _flops_per_round(X.shape[0], X.shape[1], 26, 5, 64)
+    out = {
+        "metric": _METRIC,
+        "value": round(iters_per_sec, 3),
+        "unit": "iters/sec",
+        "vs_baseline": 1.0,
+        "predict_rows_per_sec": round(rows_per_sec, 1),
+        "fit_seconds": round(fit_s, 2),
+        "train_accuracy": round(train_acc, 4),
+        "num_rounds": num_rounds,
+        "flops_per_round_est": flops,
+        "hist_precision": hist_precision,
+        "platform": platform,
+        "device": str(jax.devices()[0]),
+    }
+    if platform != "cpu":
+        # only meaningful against a real accelerator peak; a CPU "MFU"
+        # against an invented 1 TFLOP/s nominal is noise, not evidence
+        out["mfu_est"] = round(
+            flops * iters_per_sec / _peak_flops(platform), 5
+        )
+    if any(os.environ.get(k) == "1" for k in _BATTERY_KNOBS):
+        print(json.dumps({**out, "partial": "extras pending"}), flush=True)
+
     extras = {}
     if os.environ.get("BENCH_FULL") == "1":
         extras = _bench_full_extras()
@@ -609,27 +677,8 @@ def inner():
             except Exception as e:  # noqa: BLE001 - carry, keep going
                 extras[f"tier_{tier}_error"] = str(e)[:200]
 
-    flops = _flops_per_round(X.shape[0], X.shape[1], 26, 5, 64)
-    out = {
-        "metric": _METRIC,
-        "value": round(iters_per_sec, 3),
-        "unit": "iters/sec",
-        "vs_baseline": 1.0,
-        "predict_rows_per_sec": round(rows_per_sec, 1),
-        "fit_seconds": round(fit_s, 2),
-        "train_accuracy": round(train_acc, 4),
-        "num_rounds": num_rounds,
-        "flops_per_round_est": flops,
-        "hist_precision": hist_precision,
-        "platform": platform,
-        "device": str(jax.devices()[0]),
-        **extras,
-    }
-    if platform != "cpu":
-        # only meaningful against a real accelerator peak; a CPU "MFU"
-        # against an invented 1 TFLOP/s nominal is noise, not evidence
-        out["mfu_est"] = round(flops * iters_per_sec / _peak_flops(platform), 5)
-    print(json.dumps(out))
+    out.update(extras)
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

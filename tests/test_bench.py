@@ -145,3 +145,53 @@ def test_flops_estimate_positive_and_monotone():
     f1 = bench._flops_per_round(10_000, 16, 26, 5, 64)
     f2 = bench._flops_per_round(20_000, 16, 26, 5, 64)
     assert 0 < f1 < f2 and f2 == 2 * f1
+
+
+def test_run_inner_salvages_headline_from_partial_stdout(monkeypatch):
+    """A timeout mid-extras (perishable window closing) must salvage the
+    already-printed headline line instead of returning None."""
+    import subprocess as sp
+
+    bench = _load_bench()
+    partial = json.dumps({
+        "metric": "m", "value": 9.9, "platform": "tpu",
+        "num_rounds": 100, "hist_precision": "highest",
+        "partial": "extras pending",
+    })
+
+    def fake_run(*a, **k):
+        raise sp.TimeoutExpired(
+            cmd="x", timeout=5, output=f"noise\n{partial}\n", stderr=""
+        )
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    result, err = bench._run_inner(dict(), 5)
+    assert err is None
+    assert result["value"] == 9.9
+    assert "extras lost" in result["error"]
+    assert "partial" not in result and result["extras"] == "lost"
+
+    # a crash AFTER the partial print (nonzero rc, no timeout) must also
+    # surface as lost extras, not a clean success
+    class Crashed:
+        returncode = 3
+        stdout = f"{partial}\n"
+        stderr = "boom"
+
+    monkeypatch.setattr(
+        bench.subprocess, "run", lambda *a, **k: Crashed()
+    )
+    result, err = bench._run_inner(dict(), 5)
+    assert err is None and result["value"] == 9.9
+    assert "rc=3" in result["error"] and result["extras"] == "lost"
+    # a full final line (no timeout) still wins over the partial
+    full = json.dumps({"value": 1.0, "platform": "tpu"})
+
+    class P:
+        returncode = 0
+        stdout = f"{partial}\n{full}\n"
+        stderr = ""
+
+    monkeypatch.setattr(bench.subprocess, "run", lambda *a, **k: P())
+    result, err = bench._run_inner(dict(), 5)
+    assert result == {"value": 1.0, "platform": "tpu"}
