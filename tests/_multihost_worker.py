@@ -63,6 +63,17 @@ def main() -> int:
     leaves = jax.tree_util.tree_leaves(model.params)
     assert all(np.isfinite(np.asarray(leaf)).all() for leaf in leaves)
 
+    # the stream histogram tier's post-scan psum must also cross the
+    # process boundary (the HBM-scale path on a real pod)
+    from spark_ensemble_tpu import DecisionTreeRegressor
+
+    s_model = GBMRegressor(
+        num_base_learners=1,
+        base_learner=DecisionTreeRegressor(hist="stream"),
+    ).fit(X, y, mesh=m)
+    s_leaves = jax.tree_util.tree_leaves(s_model.params)
+    assert all(np.isfinite(np.asarray(leaf)).all() for leaf in s_leaves)
+
     print("MULTIHOST_OK", flush=True)
     return 0
 
