@@ -728,3 +728,24 @@ def test_mesh_round_collectives_independent_of_n(tmp_path):
         f"only@1024: {inventories[1024] - inventories[4096]}\n"
         f"only@4096: {inventories[4096] - inventories[1024]}"
     )
+
+
+def test_gbm_stream_tier_hybrid_mesh_parity():
+    """Stream tier over the multi-slice hybrid mesh: its post-scan psum
+    and scan-carry pvary must handle the TUPLE row axis
+    ("dcn_data", "data") exactly like the dense path."""
+    import spark_ensemble_tpu.ops.tree as T
+    from spark_ensemble_tpu.models.tree import DecisionTreeRegressor
+    from spark_ensemble_tpu.parallel.mesh import hybrid_data_member_mesh
+
+    X, y = _reg_data(n=768)
+    mesh = hybrid_data_member_mesh(dcn_data=2, member=2)
+    cfg = dict(num_base_learners=3, learning_rate=0.5, seed=7)
+    single = GBMRegressor(
+        base_learner=DecisionTreeRegressor(hist="stream"), **cfg
+    ).fit(X, y)
+    dist = GBMRegressor(
+        base_learner=DecisionTreeRegressor(hist="stream"), **cfg
+    ).fit(X, y, mesh=mesh)
+    r_s, r_d = _rmse(single.predict(X), y), _rmse(dist.predict(X), y)
+    assert abs(r_s - r_d) < 0.03 * max(r_s, r_d) + 1e-6, (r_s, r_d)
