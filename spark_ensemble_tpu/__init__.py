@@ -90,6 +90,13 @@ from spark_ensemble_tpu.tuning import (
     TrainValidationSplit,
     TrainValidationSplitModel,
 )
+from spark_ensemble_tpu import telemetry
+from spark_ensemble_tpu.telemetry import (
+    FitTelemetry,
+    MetricsRegistry,
+    TelemetryRecorder,
+    record_fits,
+)
 from spark_ensemble_tpu.utils.persist import load
 
 __version__ = "0.1.0"
@@ -145,5 +152,9 @@ __all__ = [
     "StandardScalerModel",
     "MinMaxScaler",
     "MinMaxScalerModel",
+    "FitTelemetry",
+    "MetricsRegistry",
+    "TelemetryRecorder",
+    "record_fits",
     "load",
 ]

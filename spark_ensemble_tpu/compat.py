@@ -1,0 +1,39 @@
+"""Version seam for jax APIs this package straddles.
+
+pyproject pins ``jax>=0.8`` (the ``jax.shard_map``/``check_vma`` API), but
+the package must still *import* — and as much as possible *run* — on older
+runtimes (the reference deployment images lag the pin).  Every module that
+needs ``shard_map`` imports it from here instead of ``jax`` directly:
+
+- jax >= 0.8: ``jax.shard_map`` with varying-axes tracking controlled by
+  ``check_vma=``.
+- older jax (< 0.4.35 era API): ``jax.experimental.shard_map.shard_map``
+  whose equivalent knob is ``check_rep=`` — the wrapper translates, so call
+  sites write the NEW spelling only.
+
+See also ``ops.collective.pvary_like_shard`` for the matching
+pcast/pvary/no-op seam inside shard_map bodies.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.8: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # older jax: experimental home, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the ``check_vma`` knob translated to the
+    running jax's spelling (``check_rep`` pre-0.8)."""
+    return _shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        **{_CHECK_KW: check_vma},
+    )

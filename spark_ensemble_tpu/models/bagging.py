@@ -26,6 +26,8 @@ psum-ed over "data", keeping the single-chip fit_forest fusion win.
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -45,6 +47,7 @@ from spark_ensemble_tpu.models.tree import (
     DecisionTreeRegressor,
 )
 from spark_ensemble_tpu.params import Param, gt_eq, in_array, in_range
+from spark_ensemble_tpu.telemetry.events import FitTelemetry
 from spark_ensemble_tpu.utils.instrumentation import instrumented_fit
 from spark_ensemble_tpu.utils.random import bootstrap_weights, subspace_mask
 
@@ -183,7 +186,7 @@ def _build_fit_all(base: BaseLearner, mesh=None, ctx_specs=None, ax=None, mem=No
     histograms, so the mesh path keeps the fit_forest fusion win."""
     if mesh is None:
         return jax.jit(_fused_fit_block(base))
-    from jax import shard_map
+    from spark_ensemble_tpu.compat import shard_map
 
     return jax.jit(
         shard_map(
@@ -230,15 +233,23 @@ class BaggingRegressor(_BaggingParams):
             ("bagging_fit", base.config_key(), mesh),
             lambda: _build_fit_all(base, mesh, ctx_specs, ax, mem),
         )
+        telem = FitTelemetry.start(self, n=n, d=d)
+        telem.phase_mark("setup")
+        t_fit = time.perf_counter()
         members = fit_all(ctx, y, fit_w, masks, keys)
-        members = jax.tree_util.tree_map(
-            lambda x: x[: self.num_base_learners], members
-        )
-        return BaggingRegressionModel(
+        m = int(self.num_base_learners)
+        if telem.enabled:
+            # every member fits in ONE fused program — all m "rounds" share
+            # the fenced program time evenly
+            telem.round_chunk(0, m, t_fit, fence=members)
+        members = jax.tree_util.tree_map(lambda x: x[:m], members)
+        model = BaggingRegressionModel(
             params={"members": members, "masks": member_masks},
             num_features=d,
             **self.get_params(),
         )
+        telem.finish(model=model, members=m)
+        return model
 
 
 class BaggingRegressionModel(RegressionModel, BaggingRegressor):
@@ -290,16 +301,24 @@ class BaggingClassifier(_BaggingParams):
             ("bagging_fit_cls", base.config_key(), num_classes, mesh),
             lambda: _build_fit_all(base, mesh, ctx_specs, ax, mem),
         )
+        telem = FitTelemetry.start(self, n=n, d=d, num_classes=int(num_classes))
+        telem.phase_mark("setup")
+        t_fit = time.perf_counter()
         members = fit_all(ctx, y, fit_w, masks, keys)
-        members = jax.tree_util.tree_map(
-            lambda x: x[: self.num_base_learners], members
-        )
-        return BaggingClassificationModel(
+        m = int(self.num_base_learners)
+        if telem.enabled:
+            # every member fits in ONE fused program — all m "rounds" share
+            # the fenced program time evenly
+            telem.round_chunk(0, m, t_fit, fence=members)
+        members = jax.tree_util.tree_map(lambda x: x[:m], members)
+        model = BaggingClassificationModel(
             params={"members": members, "masks": member_masks},
             num_features=d,
             num_classes=num_classes,
             **self.get_params(),
         )
+        telem.finish(model=model, members=m)
+        return model
 
 
 class BaggingClassificationModel(ClassificationModel, BaggingClassifier):

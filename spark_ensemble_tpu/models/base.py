@@ -355,6 +355,7 @@ class CheckpointableParams(Params):
         "checkpoint_interval",
         "checkpoint_dir",
         "profile_dir",
+        "telemetry_path",
         "feature_names",
         "scan_chunk",
     )
@@ -427,6 +428,15 @@ class Estimator(Params):
         doc="when set, every fit() captures a jax.profiler trace "
         "(TensorBoard-viewable) into this directory — the TPU analogue of "
         "the reference tests' spark.time wall-clock prints (SURVEY.md §5)",
+    )
+    telemetry_path = Param(
+        None,
+        doc="when set, every fit() appends its structured telemetry event "
+        "stream (round timings, losses, per-phase costs, compile counts, "
+        "device memory stats) to this JSONL file; the SE_TPU_TELEMETRY "
+        "environment variable is the no-code-change equivalent "
+        "(docs/telemetry.md).  Not part of any program-cache or "
+        "checkpoint-resume identity — toggling it recompiles nothing",
     )
     feature_names = Param(
         None,
@@ -606,8 +616,9 @@ class BaseLearner(Estimator):
             params = self.fit_from_ctx(ctx, y, w, None, key)
             return self.model_from_params(params, X.shape[1], num_classes)
 
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        from spark_ensemble_tpu.compat import shard_map
 
         from spark_ensemble_tpu.parallel.mesh import (
             mesh_row_spec,

@@ -44,13 +44,15 @@ Prediction:
 from __future__ import annotations
 
 import logging
-from typing import Any, List
+import time
+from typing import Any, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+from spark_ensemble_tpu.compat import shard_map
 
 from spark_ensemble_tpu.models.base import (
     BaseLearner,
@@ -77,6 +79,7 @@ from spark_ensemble_tpu.models.tree import (
 )
 from spark_ensemble_tpu.ops.collective import pmax_reduce, preduce
 from spark_ensemble_tpu.params import Param, gt_eq, in_array
+from spark_ensemble_tpu.telemetry.events import FitTelemetry
 from spark_ensemble_tpu.utils.instrumentation import (
     Instrumentation,
     instrumented_fit,
@@ -149,6 +152,7 @@ class _BoostingParams(CheckpointableParams, Estimator):
         replay,  # (extras, sum_bws, c, i) -> (#rounds kept, stop?)
         start_i: int,
         ramp: bool = False,
+        telem: Optional[FitTelemetry] = None,
     ) -> int:
         """Shared chunked round driver for both boosting flavors: chunk
         clamping to checkpoint boundaries, per-chunk key fan-out, host
@@ -183,9 +187,21 @@ class _BoostingParams(CheckpointableParams, Estimator):
             keys = jax.vmap(lambda j: jax.random.fold_in(root, j))(
                 jnp.arange(i, i + c)
             )
+            t_chunk = time.perf_counter()
             params_c, est_ws, sum_bws, bw, extras = run_chunk(keys, bw)
             sum_bws = np.asarray(sum_bws)
             kept, stop = replay(extras, sum_bws, c, i)
+            if telem is not None and telem.enabled:
+                # classifier extras = per-round errs; Drucker extras =
+                # (max_errs, est_errs) — the estimator error is the loss
+                losses = extras[1] if isinstance(extras, tuple) else extras
+                telem.round_chunk(
+                    i, kept, t_chunk,
+                    fence=(params_c, est_ws),
+                    losses=None if losses is None else np.asarray(losses)[:kept],
+                    step_sizes=np.asarray(est_ws)[:kept] if kept > 0 else None,
+                    divisor=c,
+                )
             if not stop:
                 # sequential loop guard for the NEXT round: weight mass
                 # after this chunk's last kept round must stay positive
@@ -239,6 +255,7 @@ class BoostingClassifier(_BoostingParams):
         instr = Instrumentation("BoostingClassifier.fit")
         instr.log_params(self.get_params())
         instr.log_dataset(n, d, num_classes)
+        telem = FitTelemetry.start(self, n=n, d=d, num_classes=int(num_classes))
         # snapshot the base learner: cached round-step closures must not
         # observe later set_params mutations of the caller's instance
         base = self._base().copy()
@@ -381,14 +398,15 @@ class BoostingClassifier(_BoostingParams):
             )
             logger.info("BoostingClassifier resuming from round %d", i)
 
+        telem.phase_mark("setup")
         self._drive_boosting_rounds(
             ckpt, bw, root, members_chunks, weights_chunks, run_chunk, replay,
-            i, ramp=(algorithm == "discrete"),
+            i, ramp=(algorithm == "discrete"), telem=telem,
         )
         ckpt.delete()
         num_members = int(sum(wc.shape[0] for wc in weights_chunks))
         instr.log_outcome(members=num_members)
-        return BoostingClassificationModel(
+        model = BoostingClassificationModel(
             params={
                 "members": concat_pytrees(members_chunks)
                 if members_chunks
@@ -402,6 +420,8 @@ class BoostingClassifier(_BoostingParams):
             num_members=num_members,
             **self.get_params(),
         )
+        telem.finish(model=model, members=num_members)
+        return model
 
 
 class BoostingClassificationModel(ClassificationModel, BoostingClassifier):
@@ -486,6 +506,7 @@ class BoostingRegressor(_BoostingParams):
         instr = Instrumentation("BoostingRegressor.fit")
         instr.log_params(self.get_params())
         instr.log_dataset(n, d)
+        telem = FitTelemetry.start(self, n=n, d=d)
         # snapshot the base learner: cached round-step closures must not
         # observe later set_params mutations of the caller's instance
         base = self._base().copy()
@@ -641,14 +662,15 @@ class BoostingRegressor(_BoostingParams):
             )
             logger.info("BoostingRegressor resuming from round %d", i)
 
+        telem.phase_mark("setup")
         self._drive_boosting_rounds(
             ckpt, bw, root, members_chunks, weights_chunks, run_chunk, replay,
-            i, ramp=True,
+            i, ramp=True, telem=telem,
         )
         ckpt.delete()
         num_members = int(sum(wc.shape[0] for wc in weights_chunks))
         instr.log_outcome(members=num_members)
-        return BoostingRegressionModel(
+        model = BoostingRegressionModel(
             params={
                 "members": concat_pytrees(members_chunks)
                 if members_chunks
@@ -661,6 +683,8 @@ class BoostingRegressor(_BoostingParams):
             num_members=num_members,
             **self.get_params(),
         )
+        telem.finish(model=model, members=num_members)
+        return model
 
 
 class BoostingRegressionModel(RegressionModel, BoostingRegressor):

@@ -51,13 +51,15 @@ reference's entire distribution story, `GBMClassifier.scala:325-483`):
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+from spark_ensemble_tpu.compat import shard_map
 
 from spark_ensemble_tpu.models.base import (
     BaseLearner,
@@ -86,6 +88,7 @@ from spark_ensemble_tpu.parallel.mesh import (
     shard_validation_rows,
 )
 from spark_ensemble_tpu.params import Param, gt, gt_eq, in_array, in_range
+from spark_ensemble_tpu.telemetry.events import FitTelemetry
 from spark_ensemble_tpu.utils.instrumentation import (
     Instrumentation,
     instrumented_fit,
@@ -291,6 +294,7 @@ class _GBMParams(CheckpointableParams, Estimator):
         v: int,
         best: float,
         val_history: Optional[List[float]] = None,  # mutated: per-round val losses
+        telem: Optional[FitTelemetry] = None,
     ):
         """The shared round-loop driver: scan-chunked dispatch (one program
         per `scan_chunk` rounds, single-chip AND under a mesh — validation
@@ -308,7 +312,16 @@ class _GBMParams(CheckpointableParams, Estimator):
                 # periodic saves firing at any resume offset, including a
                 # resume under a CHANGED checkpoint_interval
                 c = min(c, ckpt.rounds_until_save(i))
+            t_chunk = time.perf_counter()
             params_c, weights_c, errs = run_chunk(slice(i, i + c))
+            if telem is not None and telem.enabled:
+                # fence on the chunk outputs before reading the clock:
+                # dispatch is async and an unfenced stamp times the enqueue
+                telem.round_chunk(
+                    i, c, t_chunk,
+                    fence=(params_c, weights_c, errs),
+                    losses=errs, step_sizes=weights_c,
+                )
             members_chunks.append(params_c)
             weights_chunks.append(weights_c)
             stopped = False
@@ -400,6 +413,85 @@ def _pseudo_residuals_and_weights(
     return labels, fit_w, bag_w
 
 
+def _probe_classifier_phases(
+    telem, loss, updates, base, ctx, X, y_enc, w, bag_w, key, mask, pred,
+    alpha_ws, optimized, lr, tol, max_iter, goss,
+):
+    """Opt-in fine-phase probe (``SE_TPU_TELEMETRY_PHASES=1``): runs the
+    round's pieces as SEPARATE jitted programs on round-0 inputs and emits
+    a ``phase_probe`` event with each piece's device time.  The production
+    round fuses everything into one scan-chunked program where these
+    boundaries do not exist on the host — so the probe pays one extra
+    compile+execute per piece and its times are representative, not
+    additive with the round stream.  ``tree_fit`` covers the fused
+    histogram build + split search + leaf solve inside
+    ``fit_many_and_directions`` (op-level splits: utils/profiling.py on a
+    profiler trace).  Arrays enter as jit ARGUMENTS — closing over them
+    would constant-fold the inputs and time a different program."""
+
+    def time_once(fn, *args):
+        out = fn(*args)  # compile + warmup execution
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0, out
+
+    f_gh = jax.jit(
+        lambda y_enc, pred, bag_w, w, key: _pseudo_residuals_and_weights(
+            loss, updates, y_enc, pred, bag_w, w, goss=goss,
+            goss_key=jax.random.fold_in(key, 7),
+        )
+    )
+    f_fit = jax.jit(
+        lambda ctx, labels, fit_w, mask, key, X: base.fit_many_and_directions(
+            ctx, labels, fit_w, mask, key, X
+        )
+    )
+    f_up = jax.jit(
+        lambda pred, weight, directions: pred + weight[None, :] * directions
+    )
+
+    durations = {}
+    dt, (labels, fit_w, bag_w) = time_once(f_gh, y_enc, pred, bag_w, w, key)
+    durations["grad_hess"] = dt
+    dt, (params, directions) = time_once(
+        f_fit, ctx, labels, fit_w, mask, key, X
+    )
+    durations["tree_fit"] = dt
+    if optimized:
+
+        def _ls(y_enc, pred, directions, bag_w, alpha_ws):
+            def phi(a):
+                return jnp.sum(
+                    bag_w * loss.loss(y_enc, pred + a[None, :] * directions)
+                )
+
+            gh = None
+            if loss.has_hessian:
+                gh = lambda a: loss.linesearch_grad_hess(
+                    y_enc, pred + a[None, :] * directions, directions, bag_w
+                )
+            return projected_newton_box(
+                phi, alpha_ws, max_iter=min(max_iter, 25), tol=tol,
+                grad_hess=gh,
+            )
+
+        dt, alpha = time_once(
+            jax.jit(_ls), y_enc, pred, directions, bag_w, alpha_ws
+        )
+        durations["line_search"] = dt
+    else:
+        alpha = jnp.ones_like(alpha_ws)
+    dt, _ = time_once(f_up, pred, lr * alpha, directions)
+    durations["update"] = dt
+    telem.phase_probe(
+        durations,
+        note="tree_fit fuses histogram build + split search + leaf solve; "
+        "single-round unsharded probe, times representative not additive",
+    )
+
+
 class GBMRegressor(_GBMParams):
     """Friedman GBM regressor (reference `GBMRegressor.scala`)."""
 
@@ -481,6 +573,7 @@ class GBMRegressor(_GBMParams):
         instr = Instrumentation("GBMRegressor.fit")
         instr.log_params(self.get_params())
         instr.log_dataset(n, d)
+        telem = FitTelemetry.start(self, n=n, d=d)
         # snapshot the base learner: cached round-step closures must not
         # observe later set_params mutations of the caller's instance
         base = self._base().copy()
@@ -841,10 +934,11 @@ class GBMRegressor(_GBMParams):
                 pred_val = pred_val_new
             return params_c, weights_c, errs if with_validation else None
 
+        telem.phase_mark("setup")
         i, v, best = self._drive_rounds(
             ckpt, members_chunks, weights_chunks,
             run_chunk, save_state, "GBMRegressor", i, v, best,
-            val_history=val_history,
+            val_history=val_history, telem=telem,
         )
         ckpt.delete()
 
@@ -854,7 +948,7 @@ class GBMRegressor(_GBMParams):
         all_weights = (
             jnp.concatenate(weights_chunks) if weights_chunks else None
         )
-        return GBMRegressionModel(
+        model = GBMRegressionModel(
             params={
                 "members": slice_pytree(all_members, keep) if keep > 0 else None,
                 "weights": all_weights[:keep] if keep > 0 else jnp.zeros((0,)),
@@ -869,6 +963,8 @@ class GBMRegressor(_GBMParams):
             num_members=keep,
             **self.get_params(),
         )
+        telem.finish(model=model, rounds=i, kept_members=keep)
+        return model
 
 
 class GBMRegressionModel(RegressionModel, GBMRegressor):
@@ -971,6 +1067,7 @@ class GBMClassifier(_GBMParams):
         instr = Instrumentation("GBMClassifier.fit")
         instr.log_params(self.get_params())
         instr.log_dataset(n, d, num_classes)
+        telem = FitTelemetry.start(self, n=n, d=d, num_classes=int(num_classes))
         # snapshot the base learner: cached round-step closures must not
         # observe later set_params mutations of the caller's instance
         base = self._base().copy()
@@ -1383,10 +1480,18 @@ class GBMClassifier(_GBMParams):
                 pred_val = pred_val_new
             return params_c, weights_c, errs if with_validation else None
 
+        telem.phase_mark("setup")
+        if telem.enabled and telem.phases_enabled() and mesh is None:
+            _probe_classifier_phases(
+                telem, loss, updates, base, ctx, X, y_enc, w,
+                bag_many(bag_keys[:1])[0], bag_keys[0], masks[0], pred,
+                alpha_ws, optimized, lr, tol, max_iter, goss,
+            )
+            telem.phase_mark("probe")
         i, v, best = self._drive_rounds(
             ckpt, members_chunks, weights_chunks,
             run_chunk, save_state, "GBMClassifier", i, v, best,
-            val_history=val_history,
+            val_history=val_history, telem=telem,
         )
         ckpt.delete()
 
@@ -1396,7 +1501,7 @@ class GBMClassifier(_GBMParams):
         all_weights = (
             jnp.concatenate(weights_chunks) if weights_chunks else None
         )
-        return GBMClassificationModel(
+        model = GBMClassificationModel(
             params={
                 "members": slice_pytree(all_members, keep) if keep > 0 else None,
                 "weights": all_weights[:keep]
@@ -1414,6 +1519,8 @@ class GBMClassifier(_GBMParams):
             dim=dim,
             **self.get_params(),
         )
+        telem.finish(model=model, rounds=i, kept_members=keep)
+        return model
 
 
 class GBMClassificationModel(ClassificationModel, GBMClassifier):

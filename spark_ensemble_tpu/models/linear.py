@@ -121,7 +121,11 @@ def _lbfgs_minimize(fun, init_params, max_iter: int, tol: float):
         _, state = carry
         i = optax.tree_utils.tree_get(state, "count")
         grad = optax.tree_utils.tree_get(state, "grad")
-        err = optax.tree_utils.tree_norm(grad)
+        # optax renamed tree_l2_norm -> tree_norm; support both spellings
+        norm = getattr(
+            optax.tree_utils, "tree_norm", None
+        ) or optax.tree_utils.tree_l2_norm
+        err = norm(grad)
         return (i == 0) | ((i < max_iter) & (err >= tol))
 
     init_state = opt.init(init_params)

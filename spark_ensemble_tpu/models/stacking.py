@@ -22,6 +22,7 @@ sample weights get them dropped with a warning
 from __future__ import annotations
 
 import logging
+import time
 from typing import List
 
 import jax
@@ -44,7 +45,11 @@ from spark_ensemble_tpu.models.tree import (
     DecisionTreeRegressor,
 )
 from spark_ensemble_tpu.params import Param, in_array
-from spark_ensemble_tpu.utils.instrumentation import instrumented_fit
+from spark_ensemble_tpu.telemetry.events import FitTelemetry
+from spark_ensemble_tpu.utils.instrumentation import (
+    block_on_arrays,
+    instrumented_fit,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -73,7 +78,8 @@ class _StackingParams(Estimator):
     seed = Param(0, doc="PRNG seed (member fits are deterministic)")
 
     def _fit_bases(
-        self, bases, X, y, w, sample_weight, num_classes=None, mesh=None
+        self, bases, X, y, w, sample_weight, num_classes=None, mesh=None,
+        telem=None,
     ):
         """Fit the heterogeneous base learners, concurrently when
         ``parallelism > 1`` (order-preserving).
@@ -100,8 +106,8 @@ class _StackingParams(Estimator):
             else [None]
         ) or [None]
 
-        def fit_one(base_dev):
-            base, device = base_dev
+        def fit_one(job):
+            idx, base, device = job
             sw = w if base.supports_weight else None
             if not base.supports_weight and sample_weight is not None:
                 logger.warning(
@@ -116,16 +122,28 @@ class _StackingParams(Estimator):
                     )
                 return base.fit(X, y, sample_weight=sw)
 
+            t0 = time.perf_counter()
             if device is None:
-                return run()
-            # jax.default_device is thread-local: every array this fit
-            # creates (and thus every program it dispatches) binds to this
-            # member's device
-            with jax.default_device(device):
-                return run()
+                model = run()
+            else:
+                # jax.default_device is thread-local: every array this fit
+                # creates (and thus every program it dispatches) binds to
+                # this member's device
+                with jax.default_device(device):
+                    model = run()
+            if telem is not None and telem.enabled:
+                # fence before stamping: the member fit returns with work
+                # still in flight (with parallelism>1 member durations
+                # overlap in wall time — see docs/telemetry.md)
+                block_on_arrays(model)
+                telem.member_fit(
+                    idx, time.perf_counter() - t0,
+                    family=type(base).__name__,
+                )
+            return model
 
         jobs = [
-            (b, devices[i % len(devices)]) for i, b in enumerate(bases)
+            (i, b, devices[i % len(devices)]) for i, b in enumerate(bases)
         ]
         par = int(self.parallelism or 1)
         if par > 1 and len(bases) > 1:
@@ -151,18 +169,27 @@ class StackingRegressor(_StackingParams):
         round-robin on the mesh's devices (see ``_fit_bases``)."""
         X, y = as_f32(X), as_f32(y)
         w = resolve_weights(y, sample_weight)
-        models = self._fit_bases(self._bases(), X, y, w, sample_weight, mesh=mesh)
+        telem = FitTelemetry.start(self, n=X.shape[0], d=X.shape[1])
+        telem.phase_mark("setup")
+        models = self._fit_bases(
+            self._bases(), X, y, w, sample_weight, mesh=mesh, telem=telem
+        )
         meta = jnp.stack([m.predict(X) for m in models], axis=1)  # [n, num_bases]
         stacker = self._stacker()
         stack_model = stacker.fit(
             meta, y, sample_weight=w, **mesh_fit_kwargs(stacker, mesh)
         )
-        return StackingRegressionModel(
+        if telem.enabled:
+            block_on_arrays(stack_model)
+            telem.phase_mark("stacker")
+        model = StackingRegressionModel(
             base_models=models,
             stack_model=stack_model,
             num_features=X.shape[1],
             **self.get_params(),
         )
+        telem.finish(model=model, members=len(models))
+        return model
 
 
 class StackingRegressionModel(RegressionModel, StackingRegressor):
@@ -215,9 +242,13 @@ class StackingClassifier(_StackingParams):
         X, y = as_f32(X), as_f32(y)
         w = resolve_weights(y, sample_weight)
         num_classes = infer_num_classes(y, num_classes)
+        telem = FitTelemetry.start(
+            self, n=X.shape[0], d=X.shape[1], num_classes=int(num_classes)
+        )
+        telem.phase_mark("setup")
         models = self._fit_bases(
             self._bases(), X, y, w, sample_weight, num_classes=num_classes,
-            mesh=mesh,
+            mesh=mesh, telem=telem,
         )
         meta = self._meta_features(models, X)
         stacker = self._stacker()
@@ -227,13 +258,18 @@ class StackingClassifier(_StackingParams):
             if stacker.is_classifier
             else stacker.fit(meta, y, sample_weight=w, **kw)
         )
-        return StackingClassificationModel(
+        if telem.enabled:
+            block_on_arrays(stack_model)
+            telem.phase_mark("stacker")
+        model = StackingClassificationModel(
             base_models=models,
             stack_model=stack_model,
             num_features=X.shape[1],
             num_classes=num_classes,
             **self.get_params(),
         )
+        telem.finish(model=model, members=len(models))
+        return model
 
 
 class StackingClassificationModel(ClassificationModel, StackingClassifier):

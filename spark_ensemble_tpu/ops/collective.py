@@ -35,7 +35,18 @@ def pvary_like_shard(x, axis_name: Optional[str]):
     if axis_name is None:
         return x
     names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
-    return jax.lax.pcast(x, names, to="varying")
+    # version seam (ADVICE.md finding): jax renamed pvary -> pcast(to=
+    # "varying") around 0.8, and pyproject's jax>=0.8 floor must not
+    # AttributeError on runtimes that only have the old spelling; jax
+    # versions predating BOTH have no varying-axes tracking at all
+    # (check_rep-era shard_map), where the marking is a no-op anyway.
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, names, to="varying")
+    pvary = getattr(jax.lax, "pvary", None)
+    if pvary is not None:
+        return pvary(x, names)
+    return x
 
 
 def pmin_reduce(x, axis_name: Optional[str]):

@@ -1,0 +1,35 @@
+"""Training observability: metrics, structured fit events, compile stats.
+
+See ``docs/telemetry.md`` for how to enable the JSONL sink
+(``SE_TPU_TELEMETRY`` / the ``telemetry_path`` param), the event schema,
+and ``tools/telemetry_report.py`` for rendering streams into the same
+per-phase cost table ``utils/profiling.py`` produces from profiler traces.
+"""
+
+from spark_ensemble_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    MetricsRegistry,
+    RoundTimer,
+    StreamingHistogram,
+)
+from spark_ensemble_tpu.telemetry.events import (
+    FitTelemetry,
+    TelemetryRecorder,
+    device_memory_stats,
+    global_metrics,
+    record_fits,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "MetricsRegistry",
+    "RoundTimer",
+    "StreamingHistogram",
+    "FitTelemetry",
+    "TelemetryRecorder",
+    "device_memory_stats",
+    "global_metrics",
+    "record_fits",
+]

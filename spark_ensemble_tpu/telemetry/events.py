@@ -1,0 +1,515 @@
+"""Structured per-fit event stream: round timings, phases, compiles, memory.
+
+Every ``fit`` can emit a stream of structured events — ``fit_start``,
+``round_start``/``round_end`` pairs (loss, step size, learner index,
+duration), an optional ``phase_probe`` (fine-grained per-phase device
+costs), and a closing ``fit_end`` (per-phase wall breakdown, jit compile
+count/seconds, device memory stats).  Three sinks, checked in order:
+
+1. ``telemetry_path`` estimator param — JSONL appended at fit end,
+2. ``SE_TPU_TELEMETRY`` environment variable — same, path from the env,
+3. an active ``record_fits()`` context — events kept in memory.
+
+When none is active the per-fit handle is a shared no-op singleton: no
+events are allocated and fits stay on the exact same cached XLA programs
+(the telemetry params are not part of any program cache key), which is what
+keeps the measured enable-overhead under the budget ``bench.py`` enforces.
+
+Timing honesty under async dispatch: round durations come from fencing the
+scan-chunked round program (``block_on_arrays``, the same walk
+``instrumented_fit`` uses) and dividing the chunk wall time by the rounds
+it fused — XLA runs ``scan_chunk`` rounds as ONE dispatch, so per-round
+host timestamps inside the chunk do not exist.  The ``fit_end`` phase map
+always sums to the measured fit wall time by construction: measured spans
+plus a ``host_other`` remainder for un-spanned host work.
+
+Compile observability rides ``jax.monitoring``: a process-global listener
+counts ``backend_compile_duration`` events (cache hits emit none), and each
+fit reports the delta across its window.  Attribution is process-wide —
+concurrent fits (stacking ``parallelism>1``) each see compiles from the
+shared window, which is the truthful answer on one process-wide cache.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from spark_ensemble_tpu.telemetry.registry import MetricsRegistry
+from spark_ensemble_tpu.utils.instrumentation import block_on_arrays
+
+__all__ = [
+    "FitTelemetry",
+    "TelemetryRecorder",
+    "record_fits",
+    "device_memory_stats",
+    "global_metrics",
+]
+
+TELEMETRY_ENV = "SE_TPU_TELEMETRY"
+PHASES_ENV = "SE_TPU_TELEMETRY_PHASES"
+
+# ---------------------------------------------------------------------------
+# process-global state: metrics registry, compile listener, recorder slot
+# ---------------------------------------------------------------------------
+
+_GLOBAL = MetricsRegistry()
+
+
+def global_metrics() -> MetricsRegistry:
+    """The process-global registry (compile counters live here)."""
+    return _GLOBAL
+
+
+_COMPILE_LOCK = threading.Lock()
+_COMPILE_COUNT = 0
+_COMPILE_SECS = 0.0
+_LISTENER_STATE = {"registered": False}
+
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _on_event_duration(event: str, duration: float, **_kw) -> None:
+    global _COMPILE_COUNT, _COMPILE_SECS
+    if event == _BACKEND_COMPILE_EVENT:
+        with _COMPILE_LOCK:
+            _COMPILE_COUNT += 1
+            _COMPILE_SECS += float(duration)
+        _GLOBAL.counter("jit/compile_count").inc()
+        _GLOBAL.histogram("jit/compile_seconds").record(float(duration))
+
+
+def _ensure_compile_listener() -> None:
+    # lazy: jax.monitoring listeners are append-only (no deregistration),
+    # so nothing registers until the first telemetry-enabled fit
+    with _COMPILE_LOCK:
+        if _LISTENER_STATE["registered"]:
+            return
+        _LISTENER_STATE["registered"] = True
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(_on_event_duration)
+    except Exception:  # pragma: no cover - jax without monitoring
+        pass
+
+
+def compile_snapshot() -> tuple:
+    """(count, seconds) of backend compiles observed so far this process."""
+    with _COMPILE_LOCK:
+        return _COMPILE_COUNT, _COMPILE_SECS
+
+
+def device_memory_stats() -> Dict[str, Dict[str, int]]:
+    """Per-device allocator stats from ``device.memory_stats()``; backends
+    without an allocator report (CPU) simply drop out of the map."""
+    import jax
+
+    out: Dict[str, Dict[str, int]] = {}
+    for i, dev in enumerate(jax.local_devices()):
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        keep = {}
+        for key in ("bytes_in_use", "peak_bytes_in_use", "largest_alloc_size",
+                    "bytes_limit", "num_allocs"):
+            if key in stats:
+                keep[key] = int(stats[key])
+        out[f"{dev.platform}:{i}"] = keep or {
+            k: int(v) for k, v in stats.items() if isinstance(v, (int, float))
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# sinks
+# ---------------------------------------------------------------------------
+
+
+class TelemetryRecorder:
+    """Thread-safe in-memory event sink (stacking fits members from a
+    thread pool, and each member fit emits into the same recorder)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+
+    def record(self, event: Dict[str, Any]) -> None:
+        with self._lock:
+            self._events.append(event)
+
+    def extend(self, events: List[Dict[str, Any]]) -> None:
+        with self._lock:
+            self._events.extend(events)
+
+    @property
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def fits(self) -> Dict[str, List[Dict[str, Any]]]:
+        """Events grouped by fit id, in emission order."""
+        out: Dict[str, List[Dict[str, Any]]] = {}
+        for ev in self.events:
+            out.setdefault(ev.get("fit_id", "?"), []).append(ev)
+        return out
+
+
+_RECORDER_LOCK = threading.Lock()
+_RECORDER: Optional[TelemetryRecorder] = None
+
+
+@contextlib.contextmanager
+def record_fits() -> Iterator[TelemetryRecorder]:
+    """Capture every fit's event stream in memory for the duration of the
+    context — the programmatic alternative to the JSONL sinks::
+
+        with telemetry.record_fits() as rec:
+            model = GBMClassifier(...).fit(X, y)
+        rounds = [e for e in rec.events if e["event"] == "round_end"]
+
+    A module-level slot rather than a contextvar on purpose: stacking
+    fits members from worker threads, and those threads must see the
+    recorder the caller installed."""
+    global _RECORDER
+    rec = TelemetryRecorder()
+    with _RECORDER_LOCK:
+        prev, _RECORDER = _RECORDER, rec
+    try:
+        yield rec
+    finally:
+        with _RECORDER_LOCK:
+            _RECORDER = prev
+
+
+def _active_recorder() -> Optional[TelemetryRecorder]:
+    with _RECORDER_LOCK:
+        return _RECORDER
+
+
+_JSONL_LOCK = threading.Lock()
+
+
+def _append_jsonl(path: str, events: List[Dict[str, Any]]) -> None:
+    lines = [json.dumps(ev, sort_keys=True, default=float) for ev in events]
+    with _JSONL_LOCK:
+        with open(path, "a") as f:
+            for line in lines:
+                f.write(line + "\n")
+
+
+# ---------------------------------------------------------------------------
+# per-fit handle
+# ---------------------------------------------------------------------------
+
+_FIT_SEQ = itertools.count()
+
+
+class FitTelemetry:
+    """Per-fit event emitter; ``FitTelemetry.start(...)`` returns a shared
+    no-op singleton when no sink is active, so the disabled path costs one
+    attribute check per call site and allocates nothing."""
+
+    enabled = True
+
+    def __init__(self, family: str, path: Optional[str],
+                 recorder: Optional[TelemetryRecorder]):
+        self.family = family
+        self.fit_id = f"{family}:{os.getpid()}:{next(_FIT_SEQ)}"
+        self._path = path
+        self._recorder = recorder
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._phases: Dict[str, float] = {}
+        self._rounds = 0
+        self._finished = False
+        self._t0 = time.perf_counter()
+        self._last_mark = self._t0
+        _ensure_compile_listener()
+        self._compile0 = compile_snapshot()
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def start(cls, estimator=None, family: str = "", n: Optional[int] = None,
+              d: Optional[int] = None, telemetry_path: Optional[str] = None,
+              **meta) -> "FitTelemetry":
+        """Resolve the sink (param > env > in-memory recorder) and open the
+        stream; returns the disabled singleton when nothing is listening."""
+        path = telemetry_path or getattr(estimator, "telemetry_path", None)
+        path = path or os.environ.get(TELEMETRY_ENV) or None
+        recorder = _active_recorder()
+        if not path and recorder is None:
+            return _DISABLED
+        if not family and estimator is not None:
+            family = type(estimator).__name__
+        telem = cls(family, path, recorder)
+        start_ev = {"event": "fit_start", "family": family}
+        if n is not None:
+            start_ev["n"] = int(n)
+        if d is not None:
+            start_ev["d"] = int(d)
+        start_ev.update(meta)
+        telem._emit(start_ev)
+        return telem
+
+    # -- emission ---------------------------------------------------------
+
+    def _emit(self, event: Dict[str, Any]) -> None:
+        event = dict(event)
+        event.setdefault("fit_id", self.fit_id)
+        event.setdefault("ts", time.time())
+        with self._lock:
+            self._events.append(event)
+        if self._recorder is not None:
+            self._recorder.record(event)
+
+    def phase_mark(self, name: str) -> None:
+        """Charge the host time since the previous mark (or fit start) to
+        phase ``name`` — the span bookkeeping that makes the ``fit_end``
+        phase map sum to wall time by construction."""
+        now = time.perf_counter()
+        with self._lock:
+            self._phases[name] = self._phases.get(name, 0.0) + (
+                now - self._last_mark
+            )
+            self._last_mark = now
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        """Measure a block into phase ``name`` without disturbing the
+        running mark (for out-of-line work like checkpoint waits)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._phases[name] = self._phases.get(name, 0.0) + dt
+
+    def round_chunk(self, start_round: int, count: int, t0: float,
+                    fence: Any = (), losses: Any = None, step_sizes: Any = None,
+                    learner_index: Optional[int] = None,
+                    phase: str = "rounds",
+                    divisor: Optional[int] = None) -> float:
+        """Record ``count`` rounds dispatched as one fused program: fence on
+        the chunk outputs, then emit a ``round_start``/``round_end`` pair per
+        round at chunk_duration/count each (see module docstring: per-round
+        host timestamps inside a scan chunk do not exist).  ``divisor``
+        overrides the per-round denominator when the chunk COMPUTED more
+        rounds than it kept (boosting aborts discard the tail)."""
+        if fence is not None and fence != ():
+            block_on_arrays(fence)
+        now = time.perf_counter()
+        duration = now - t0
+        per_round = duration / max(divisor if divisor else count, 1)
+        loss_arr = None if losses is None else np.asarray(losses).reshape(-1)
+        step_arr = None
+        if step_sizes is not None:
+            step_arr = np.asarray(step_sizes, dtype=np.float64)
+            step_arr = step_arr.reshape(step_arr.shape[0], -1).mean(axis=1)
+        mem = device_memory_stats()
+        for j in range(count):
+            rnd = start_round + j
+            li = rnd if learner_index is None else learner_index
+            self._emit({"event": "round_start", "round": rnd,
+                        "learner_index": li})
+            end_ev: Dict[str, Any] = {
+                "event": "round_end",
+                "round": rnd,
+                "learner_index": li,
+                "duration_s": per_round,
+                "phases": {"device_round": per_round},
+            }
+            if loss_arr is not None and j < loss_arr.shape[0]:
+                end_ev["loss"] = float(loss_arr[j])
+            if step_arr is not None and j < step_arr.shape[0]:
+                end_ev["step_size"] = float(step_arr[j])
+            if mem:
+                end_ev["memory"] = mem
+            self._emit(end_ev)
+        with self._lock:
+            self._rounds += count
+            self._phases[phase] = self._phases.get(phase, 0.0) + duration
+            self._last_mark = now
+        return duration
+
+    def member_fit(self, learner_index: int, duration_s: float,
+                   loss: Optional[float] = None,
+                   family: Optional[str] = None) -> None:
+        """One sequentially-fitted member (stacking base learners): a
+        round_start/round_end pair whose round index IS the learner index."""
+        self._emit({"event": "round_start", "round": learner_index,
+                    "learner_index": learner_index})
+        ev: Dict[str, Any] = {
+            "event": "round_end",
+            "round": learner_index,
+            "learner_index": learner_index,
+            "duration_s": float(duration_s),
+            "phases": {"member_fit": float(duration_s)},
+        }
+        if loss is not None:
+            ev["loss"] = float(loss)
+        if family:
+            ev["member_family"] = family
+        mem = device_memory_stats()
+        if mem:
+            ev["memory"] = mem
+        self._emit(ev)
+        with self._lock:
+            self._rounds += 1
+            self._phases["rounds"] = (
+                self._phases.get("rounds", 0.0) + float(duration_s)
+            )
+            self._last_mark = time.perf_counter()
+
+    def phase_probe(self, phases: Dict[str, float],
+                    note: Optional[str] = None) -> None:
+        """Fine-grained per-phase device costs from a one-round probe (see
+        ``SE_TPU_TELEMETRY_PHASES``); informational — probe time is charged
+        to the ``probe`` phase, not to the rounds."""
+        ev: Dict[str, Any] = {
+            "event": "phase_probe",
+            "phases": {k: float(v) for k, v in phases.items()},
+        }
+        if note:
+            ev["note"] = note
+        self._emit(ev)
+
+    def finish(self, model=None, **outcome) -> None:
+        """Close the stream: charge the un-marked tail to ``finalize``,
+        add the ``host_other`` remainder so phases sum EXACTLY to wall,
+        emit ``fit_end``, flush the JSONL sink, and attach
+        ``model.fit_history_``."""
+        if self._finished:
+            return
+        self._finished = True
+        self.phase_mark("finalize")
+        wall = time.perf_counter() - self._t0
+        with self._lock:
+            phases = dict(self._phases)
+        other = wall - sum(phases.values())
+        if abs(other) > 1e-9:
+            phases["host_other"] = other
+        c1, s1 = compile_snapshot()
+        ev: Dict[str, Any] = {
+            "event": "fit_end",
+            "family": self.family,
+            "wall_s": wall,
+            "rounds": self._rounds,
+            "phases": phases,
+            "compile_count": c1 - self._compile0[0],
+            "compile_s": s1 - self._compile0[1],
+        }
+        mem = device_memory_stats()
+        if mem:
+            ev["memory"] = mem
+        ev.update(outcome)
+        self._emit(ev)
+        if self._path:
+            with self._lock:
+                events = list(self._events)
+            _append_jsonl(self._path, events)
+        if model is not None:
+            model.fit_history_ = self.history()
+
+    # -- consumption ------------------------------------------------------
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def history(self) -> Dict[str, np.ndarray]:
+        """Recorded rounds as aligned arrays — the ``fit_history_`` payload
+        (round, learner_index, duration_s, loss, step_size; loss/step_size
+        are NaN where a family does not produce them)."""
+        ends = [e for e in self.events() if e["event"] == "round_end"]
+        if not ends:
+            return {
+                "round": np.zeros(0, np.int64),
+                "learner_index": np.zeros(0, np.int64),
+                "duration_s": np.zeros(0, np.float64),
+                "loss": np.zeros(0, np.float64),
+                "step_size": np.zeros(0, np.float64),
+            }
+        return {
+            "round": np.array([e["round"] for e in ends], np.int64),
+            "learner_index": np.array(
+                [e["learner_index"] for e in ends], np.int64
+            ),
+            "duration_s": np.array(
+                [e.get("duration_s", np.nan) for e in ends], np.float64
+            ),
+            "loss": np.array(
+                [e.get("loss", np.nan) for e in ends], np.float64
+            ),
+            "step_size": np.array(
+                [e.get("step_size", np.nan) for e in ends], np.float64
+            ),
+        }
+
+    @staticmethod
+    def phases_enabled() -> bool:
+        """Whether the opt-in fine-phase probe should run (it costs one
+        extra single-round compile + execution per fit)."""
+        return os.environ.get(PHASES_ENV, "") not in ("", "0")
+
+
+class _DisabledFitTelemetry(FitTelemetry):
+    """Shared no-op: every method returns immediately, no state mutates."""
+
+    enabled = False
+
+    def __init__(self):  # noqa: D401 - deliberately skip parent init
+        self.family = ""
+        self.fit_id = ""
+
+    def _emit(self, event):
+        pass
+
+    def phase_mark(self, name):
+        pass
+
+    @contextlib.contextmanager
+    def span(self, name):
+        yield
+
+    def round_chunk(self, *a, **kw):
+        return 0.0
+
+    def member_fit(self, *a, **kw):
+        pass
+
+    def phase_probe(self, *a, **kw):
+        pass
+
+    def finish(self, model=None, **outcome):
+        if model is not None and not hasattr(model, "fit_history_"):
+            # the attribute is part of the fitted-model contract whether or
+            # not telemetry ran; empty arrays keep downstream code uniform
+            model.fit_history_ = self.history()
+
+    def events(self):
+        return []
+
+    def history(self):
+        return {
+            "round": np.zeros(0, np.int64),
+            "learner_index": np.zeros(0, np.int64),
+            "duration_s": np.zeros(0, np.float64),
+            "loss": np.zeros(0, np.float64),
+            "step_size": np.zeros(0, np.float64),
+        }
+
+
+_DISABLED = _DisabledFitTelemetry()

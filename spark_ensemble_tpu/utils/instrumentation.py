@@ -8,6 +8,11 @@ equivalent: an ``instrumented`` context manager that logs estimator params
 on entry and outcome on exit, per-round ``log_named_value``, and an optional
 ``jax.profiler`` trace context for TPU timeline capture (the reference has
 no profiler integration; tests used ``spark.time`` wall-clock prints).
+
+This layer is human-readable logging; the machine-readable counterpart is
+``spark_ensemble_tpu.telemetry`` (structured per-round event stream, JSONL
+sink, ``fit_history_`` — docs/telemetry.md), which reuses ``block_on_arrays``
+below as its async-dispatch fence.
 """
 
 from __future__ import annotations

@@ -126,6 +126,26 @@ def format_summary(
     return "\n".join(lines)
 
 
+def rows_to_records(
+    rows: List[Tuple[str, float, int]], total_us: Optional[float] = None
+) -> List[dict]:
+    """The machine-readable form of the cost table: one record per op with
+    ``{"op", "total_us", "count", "share"}`` — the SAME schema
+    ``tools/telemetry_report.py`` emits for telemetry phases, so trace
+    summaries and telemetry reports diff against each other directly."""
+    total = total_us if total_us else (sum(r[1] for r in rows) or 1.0)
+    return [
+        {"op": name, "total_us": us, "count": count, "share": us / total}
+        for name, us, count in rows
+    ]
+
+
+def write_jsonl(records: List[dict], path: str) -> None:
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
 
@@ -142,6 +162,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         action="store_true",
         help="sum across ALL captures under the dir (default: latest only)",
     )
+    ap.add_argument(
+        "--jsonl",
+        metavar="PATH",
+        help="also write the table as JSONL records "
+        '{"op","total_us","count","share"} — the shared machine-readable '
+        "format tools/telemetry_report.py reads and emits",
+    )
     args = ap.parse_args(argv)
     rows, total = summarize_trace(
         args.trace_dir,
@@ -152,6 +179,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not rows:
         print(f"no trace events found under {args.trace_dir}")
         return 1
+    if args.jsonl:
+        write_jsonl(rows_to_records(rows, total), args.jsonl)
     print(format_summary(rows, total))
     return 0
 

@@ -160,7 +160,7 @@ class _SpyBoostingClassifier(se.BoostingClassifier):
     """Records the chunk sizes the round driver dispatches."""
 
     def _drive_boosting_rounds(self, ckpt, bw, root, mc, wc, run_chunk,
-                               replay, start_i, ramp=False):
+                               replay, start_i, ramp=False, telem=None):
         self.dispatched = []
 
         def spy(keys, bw):
@@ -168,7 +168,8 @@ class _SpyBoostingClassifier(se.BoostingClassifier):
             return run_chunk(keys, bw)
 
         return super()._drive_boosting_rounds(
-            ckpt, bw, root, mc, wc, spy, replay, start_i, ramp=ramp
+            ckpt, bw, root, mc, wc, spy, replay, start_i, ramp=ramp,
+            telem=telem,
         )
 
 

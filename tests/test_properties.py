@@ -13,7 +13,12 @@ Two environment constraints shape these tests:
 import jax
 import jax.numpy as jnp
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis extra"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from spark_ensemble_tpu.ops import losses as losses_mod
 from spark_ensemble_tpu.utils.quantile import weighted_median

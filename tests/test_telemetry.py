@@ -1,0 +1,319 @@
+"""Telemetry tests (docs/telemetry.md): metric primitives, the per-fit
+event stream on all four ensemble families, JSONL round-trip, the report
+CLI, and the disabled-path contract (no events, same programs)."""
+
+import gzip
+import importlib.util
+import json
+import math
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import spark_ensemble_tpu as se
+from spark_ensemble_tpu.telemetry import (
+    FitTelemetry,
+    MetricsRegistry,
+    record_fits,
+)
+from spark_ensemble_tpu.telemetry.events import TELEMETRY_ENV
+from spark_ensemble_tpu.telemetry.registry import StreamingHistogram
+
+_REPORT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools", "telemetry_report.py",
+)
+
+
+def _load_report():
+    spec = importlib.util.spec_from_file_location("telemetry_report", _REPORT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _data(n=200, d=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] + 0.1 * rng.randn(n)).astype(np.float32)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# MetricsRegistry primitives
+# ---------------------------------------------------------------------------
+
+
+def test_counter_and_gauge_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("fits")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    assert reg.counter("fits") is c  # get-or-create returns the same metric
+    g = reg.gauge("bytes")
+    assert g.value is None
+    g.set(7.0)
+    g.set(3.0)
+    assert g.value == 3.0  # last write wins
+    snap = reg.snapshot()
+    assert snap["fits"] == {"type": "counter", "value": 5}
+    assert snap["bytes"] == {"type": "gauge", "value": 3.0}
+    assert reg.names() == ["bytes", "fits"]
+
+
+def test_registry_rejects_kind_mismatch():
+    reg = MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(TypeError, match="already registered"):
+        reg.gauge("x")
+    with pytest.raises(TypeError):
+        reg.histogram("x")
+
+
+def test_streaming_histogram_summary_and_quantiles():
+    h = StreamingHistogram("t")
+    assert h.quantile(0.5) is None and h.summary() == {
+        "type": "histogram", "count": 0,
+    }
+    values = [0.001, 0.002, 0.004, 0.008, 1.0]
+    for v in values:
+        h.record(v)
+    s = h.summary()
+    assert s["count"] == 5
+    assert s["min"] == 0.001 and s["max"] == 1.0
+    assert math.isclose(s["mean"], sum(values) / 5)
+    # log2 buckets: quantile answers are upper bucket edges, within 2x
+    assert 0.002 <= s["p50"] <= 0.008
+    assert s["p99"] == 1.0  # clamped to the observed max
+    h.record(-1.0)  # non-positive values clamp into the bottom bucket
+    assert h.count == 6
+
+
+def test_round_timer_fences_device_work():
+    reg = MetricsRegistry()
+    t = reg.timer("round")
+    f = jax.jit(lambda a: (a @ a).sum())
+    x = jax.numpy.ones((64, 64))
+    t.start()
+    out = f(x)
+    elapsed = t.stop(out)
+    assert elapsed > 0.0
+    # the fence blocked on the result before the clock read
+    assert getattr(out, "is_ready", lambda: True)()
+    hist = reg.histogram("round")  # timers share the named histogram
+    assert hist.count == 1
+    out2 = t.time(f, x)
+    assert hist.count == 2 and float(out2) == float(out)
+    with pytest.raises(RuntimeError, match="before start"):
+        t.stop()
+    # timers are per-caller handles over a shared histogram, not shared state
+    assert reg.timer("round") is not reg.timer("round")
+
+
+# ---------------------------------------------------------------------------
+# event stream: sinks + JSONL round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_roundtrip_and_phase_sum(tmp_path):
+    path = str(tmp_path / "fit.jsonl")
+    X, y = _data()
+    model = se.GBMRegressor(num_base_learners=4, telemetry_path=path).fit(X, y)
+    events = [json.loads(line) for line in open(path)]
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "fit_start" and kinds[-1] == "fit_end"
+    fit_end = events[-1]
+    assert fit_end["family"] == "GBMRegressor"
+    # phase map sums EXACTLY to the measured wall (host_other remainder)
+    assert math.isclose(
+        sum(fit_end["phases"].values()), fit_end["wall_s"], rel_tol=1e-6
+    )
+    ends = [e for e in events if e["event"] == "round_end"]
+    assert len(ends) == fit_end["rounds"] == 4
+    rounds = [e["round"] for e in ends]
+    assert rounds == sorted(rounds) and len(set(rounds)) == len(rounds)
+    assert all(e["duration_s"] > 0 for e in ends)
+    assert fit_end["compile_count"] >= 0
+    # the same history the JSONL carries is attached to the model
+    np.testing.assert_array_equal(model.fit_history_["round"], rounds)
+
+
+def test_env_var_sink(tmp_path, monkeypatch):
+    path = str(tmp_path / "env.jsonl")
+    monkeypatch.setenv(TELEMETRY_ENV, path)
+    X, y = _data()
+    se.BaggingRegressor(num_base_learners=3).fit(X, y)
+    events = [json.loads(line) for line in open(path)]
+    assert events[0]["event"] == "fit_start"
+    assert events[0]["family"] == "BaggingRegressor"
+
+
+def test_record_fits_in_memory_recorder(monkeypatch):
+    monkeypatch.delenv(TELEMETRY_ENV, raising=False)
+    X, y = _data()
+    with record_fits() as rec:
+        se.GBMRegressor(num_base_learners=2).fit(X, y)
+        se.BaggingRegressor(num_base_learners=2).fit(X, y)
+    fits = rec.fits()
+    assert len(fits) == 2
+    for fit_events in fits.values():
+        assert fit_events[0]["event"] == "fit_start"
+        assert fit_events[-1]["event"] == "fit_end"
+    # the context is scoped: fits outside it record nothing new
+    n = len(rec.events)
+    se.GBMRegressor(num_base_learners=2).fit(X, y)
+    assert len(rec.events) == n
+
+
+# ---------------------------------------------------------------------------
+# fit_history_ on every family
+# ---------------------------------------------------------------------------
+
+
+def _families():
+    X, y = _data(n=250, d=5)
+    return [
+        ("gbm", se.GBMRegressor(num_base_learners=4), X, y),
+        (
+            "boosting",
+            se.BoostingRegressor(
+                base_learner=se.DecisionTreeRegressor(max_depth=3),
+                num_base_learners=3,
+            ),
+            X, y,
+        ),
+        ("bagging", se.BaggingRegressor(num_base_learners=3), X, y),
+        (
+            "stacking",
+            se.StackingRegressor(
+                base_learners=[
+                    se.DecisionTreeRegressor(max_depth=3),
+                    se.LinearRegression(),
+                ],
+                stacker=se.LinearRegression(),
+            ),
+            X, y,
+        ),
+    ]
+
+
+@pytest.mark.parametrize(
+    "name,est,X,y", _families(), ids=lambda v: v if isinstance(v, str) else ""
+)
+def test_fit_history_present_and_monotone(name, est, X, y, monkeypatch):
+    monkeypatch.delenv(TELEMETRY_ENV, raising=False)
+    with record_fits():
+        model = est.fit(X, y)
+    h = model.fit_history_
+    assert set(h) == {"round", "learner_index", "duration_s", "loss",
+                      "step_size"}
+    assert len(h["round"]) > 0
+    assert all(len(h[k]) == len(h["round"]) for k in h)
+    assert np.all(np.diff(h["round"]) >= 0), f"{name}: rounds not monotone"
+    assert np.all(h["duration_s"] >= 0)
+
+
+def test_gbm_history_carries_losses_and_step_sizes(monkeypatch):
+    monkeypatch.delenv(TELEMETRY_ENV, raising=False)
+    X, y = _data()
+    # losses are per-round validation errors, so hold out a validation slice
+    vi = np.zeros(len(y), bool)
+    vi[::4] = True
+    with record_fits():
+        model = se.GBMRegressor(
+            num_base_learners=5, num_rounds=5, validation_tol=1e-6
+        ).fit(X, y, validation_indicator=vi)
+    h = model.fit_history_
+    assert len(h["round"]) > 0
+    assert np.all(np.isfinite(h["loss"]))
+    assert np.all(np.isfinite(h["step_size"]))
+    assert np.all(np.diff(h["round"]) == 1)  # strictly consecutive
+
+
+# ---------------------------------------------------------------------------
+# disabled path
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_fit_emits_nothing_and_attaches_empty_history(monkeypatch):
+    monkeypatch.delenv(TELEMETRY_ENV, raising=False)
+    a = FitTelemetry.start(family="x")
+    b = FitTelemetry.start(family="y")
+    assert a is b and not a.enabled  # shared no-op singleton, no allocation
+    assert a.events() == []
+    X, y = _data()
+    model = se.GBMRegressor(num_base_learners=2).fit(X, y)
+    h = model.fit_history_  # contract: always present
+    assert all(len(v) == 0 for v in h.values())
+
+
+def test_compile_counting_rides_fit_end(monkeypatch):
+    monkeypatch.delenv(TELEMETRY_ENV, raising=False)
+    # a shape no other test uses forces at least one fresh backend compile
+    X, y = _data(n=331, d=7, seed=3)
+    with record_fits() as rec:
+        se.GBMRegressor(num_base_learners=2).fit(X, y)
+    fit_end = rec.events[-1]
+    assert fit_end["event"] == "fit_end"
+    assert fit_end["compile_count"] >= 1
+    assert fit_end["compile_s"] > 0.0
+
+
+# ---------------------------------------------------------------------------
+# report CLI + shared machine-readable format
+# ---------------------------------------------------------------------------
+
+
+def test_report_cli_renders_stream(tmp_path, capsys):
+    path = str(tmp_path / "fit.jsonl")
+    X, y = _data()
+    se.GBMRegressor(num_base_learners=3, telemetry_path=path).fit(X, y)
+    report = _load_report()
+    out_jsonl = str(tmp_path / "phases.jsonl")
+    assert report.main([path, "--jsonl", out_jsonl]) == 0
+    out = capsys.readouterr().out
+    assert "total_ms" in out and "GBMRegressor" in out
+    assert "wall:" in out and "compiles:" in out and "rounds: 3" in out
+    records = [json.loads(line) for line in open(out_jsonl)]
+    assert records and set(records[0]) == {"op", "total_us", "count", "share"}
+    assert math.isclose(sum(r["share"] for r in records), 1.0, rel_tol=1e-6)
+    # --diff consumes the same format this tool (and profiling) emits
+    assert report.main([path, "--diff", out_jsonl]) == 0
+    assert "delta%" in capsys.readouterr().out
+
+
+def test_report_cli_empty_stream_fails(tmp_path, capsys):
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    report = _load_report()
+    assert report.main([str(empty)]) == 1
+
+
+def test_profiling_jsonl_mode(tmp_path, capsys):
+    from spark_ensemble_tpu.utils import profiling
+
+    capture = tmp_path / "prof" / "plugins" / "profile" / "2026_08_05"
+    capture.mkdir(parents=True)
+    trace = {
+        "traceEvents": [
+            {"ph": "X", "dur": 300.0, "name": "fusion.1"},
+            {"ph": "X", "dur": 100.0, "name": "fusion.1"},
+            {"ph": "X", "dur": 600.0, "name": "dot.2"},
+            {"ph": "X", "dur": 999.0, "name": "Thread 1"},  # host row, dropped
+            {"ph": "M", "name": "metadata"},  # not a slice
+        ]
+    }
+    with gzip.open(capture / "host.trace.json.gz", "wt") as f:
+        json.dump(trace, f)
+    out_jsonl = str(tmp_path / "ops.jsonl")
+    assert profiling.main([str(tmp_path / "prof"), "--jsonl", out_jsonl]) == 0
+    assert "total_ms" in capsys.readouterr().out
+    records = [json.loads(line) for line in open(out_jsonl)]
+    by_op = {r["op"]: r for r in records}
+    assert set(by_op) == {"fusion.1", "dot.2"}
+    assert by_op["fusion.1"]["total_us"] == 400.0
+    assert by_op["fusion.1"]["count"] == 2
+    assert math.isclose(by_op["dot.2"]["share"], 0.6)

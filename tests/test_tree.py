@@ -125,7 +125,7 @@ def test_sharded_histogram_fit_matches_single_device():
     """Data-parallel tree fit via shard_map + psum == single-device fit."""
     import functools
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
+    from spark_ensemble_tpu.compat import shard_map
 
     X, y = _data(1024, 4)
     b = compute_bins(X, 32)
@@ -281,7 +281,7 @@ def test_fit_forest_sharded_matches_single_device():
     single-device fused forest."""
     import functools
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
+    from spark_ensemble_tpu.compat import shard_map
     from spark_ensemble_tpu.ops.tree import fit_forest
 
     rng = np.random.RandomState(13)
@@ -792,7 +792,7 @@ def test_stream_tier_sharded_matches_single_device(monkeypatch):
     single-device stream fit (and the collective stays O(nodes·bins·k))."""
     import functools
 
-    from jax import shard_map
+    from spark_ensemble_tpu.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     import spark_ensemble_tpu.ops.tree as T

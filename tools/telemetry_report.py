@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""Render a telemetry JSONL stream (SE_TPU_TELEMETRY / telemetry_path) into
+the per-phase cost table ``spark_ensemble_tpu.utils.profiling`` produces
+from profiler traces — same columns, same shapes, so the two views of a run
+read (and diff) the same way:
+
+    SE_TPU_TELEMETRY=/tmp/fit.jsonl python train.py
+    python tools/telemetry_report.py /tmp/fit.jsonl
+
+Per fit: the ``fit_end`` phase map as a total_ms/%/count table (count = the
+rounds that contributed to the phase), round statistics, compile counts,
+and — when a ``phase_probe`` event is present — the probe's fine-phase
+split.  ``--jsonl PATH`` re-emits the aggregated table as
+``{"op","total_us","count","share"}`` records (the format
+``utils/profiling.py --jsonl`` writes), and ``--diff OTHER.jsonl`` compares
+against such a file.
+
+Pure stdlib + the profiling formatter: usable on a host with no jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spark_ensemble_tpu.utils.profiling import (  # noqa: E402
+    format_summary,
+    rows_to_records,
+    write_jsonl,
+)
+
+
+def load_events(path: str) -> List[dict]:
+    events = []
+    with open(path) as f:
+        for line_no, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                print(
+                    f"warning: {path}:{line_no}: bad JSON ({e})",
+                    file=sys.stderr,
+                )
+    return events
+
+
+def group_fits(events: List[dict]) -> Dict[str, List[dict]]:
+    fits: Dict[str, List[dict]] = {}
+    for ev in events:
+        fits.setdefault(ev.get("fit_id", "?"), []).append(ev)
+    return fits
+
+
+def fit_phase_rows(
+    fit_events: List[dict],
+) -> Tuple[List[Tuple[str, float, int]], float]:
+    """``fit_end`` phases -> profiling-shaped rows [(name, total_us, count)]
+    + grand total; the per-phase count is the number of round_end events
+    charged to it (1 for one-shot phases like setup/finalize)."""
+    fit_end = next(
+        (e for e in fit_events if e.get("event") == "fit_end"), None
+    )
+    if fit_end is None:
+        return [], 0.0
+    round_counts: Dict[str, int] = {}
+    for ev in fit_events:
+        if ev.get("event") != "round_end":
+            continue
+        for name in ev.get("phases", {"rounds": None}):
+            # chunked round phases land in the fit-level "rounds" bucket;
+            # member fits in "rounds" too (see FitTelemetry)
+            round_counts["rounds"] = round_counts.get("rounds", 0) + 1
+            break
+    rows = []
+    for name, secs in fit_end.get("phases", {}).items():
+        rows.append((name, float(secs) * 1e6, round_counts.get(name, 1)))
+    rows.sort(key=lambda r: -r[1])
+    total = sum(r[1] for r in rows)
+    return rows, total
+
+
+def round_stats(fit_events: List[dict]) -> Optional[dict]:
+    ends = [e for e in fit_events if e.get("event") == "round_end"]
+    if not ends:
+        return None
+    durs = sorted(float(e.get("duration_s", 0.0)) for e in ends)
+    losses = [e["loss"] for e in ends if "loss" in e]
+    out = {
+        "rounds": len(ends),
+        "mean_s": sum(durs) / len(durs),
+        "p50_s": durs[len(durs) // 2],
+        "max_s": durs[-1],
+    }
+    if losses:
+        out["first_loss"] = losses[0]
+        out["last_loss"] = losses[-1]
+    return out
+
+
+def render_fit(fit_id: str, fit_events: List[dict]) -> str:
+    lines = [f"== {fit_id} =="]
+    start = next(
+        (e for e in fit_events if e.get("event") == "fit_start"), None
+    )
+    fit_end = next(
+        (e for e in fit_events if e.get("event") == "fit_end"), None
+    )
+    if start:
+        dims = ", ".join(
+            f"{k}={start[k]}" for k in ("n", "d", "num_classes") if k in start
+        )
+        if dims:
+            lines.append(f"dataset: {dims}")
+    rows, total = fit_phase_rows(fit_events)
+    if rows:
+        lines.append(format_summary(rows, total))
+    if fit_end:
+        lines.append(
+            f"wall: {float(fit_end.get('wall_s', 0.0)):.3f}s  "
+            f"compiles: {fit_end.get('compile_count', '?')} "
+            f"({float(fit_end.get('compile_s', 0.0)):.3f}s)"
+        )
+        mem = fit_end.get("memory") or {}
+        for dev, stats in sorted(mem.items()):
+            peak = stats.get("peak_bytes_in_use")
+            if peak is not None:
+                lines.append(f"memory[{dev}]: peak {peak / 2**20:.1f} MiB")
+    stats = round_stats(fit_events)
+    if stats:
+        loss_part = (
+            f"  loss {stats['first_loss']:.6g} -> {stats['last_loss']:.6g}"
+            if "first_loss" in stats
+            else ""
+        )
+        lines.append(
+            f"rounds: {stats['rounds']}  mean {stats['mean_s'] * 1e3:.2f}ms  "
+            f"p50 {stats['p50_s'] * 1e3:.2f}ms  max {stats['max_s'] * 1e3:.2f}ms"
+            f"{loss_part}"
+        )
+    probe = next(
+        (e for e in fit_events if e.get("event") == "phase_probe"), None
+    )
+    if probe:
+        probe_rows = sorted(
+            ((k, float(v) * 1e6, 1) for k, v in probe["phases"].items()),
+            key=lambda r: -r[1],
+        )
+        lines.append("fine-phase probe (single round, representative):")
+        lines.append(format_summary(probe_rows, sum(r[1] for r in probe_rows)))
+    return "\n".join(lines)
+
+
+def aggregate_rows(
+    fits: Dict[str, List[dict]],
+) -> Tuple[List[Tuple[str, float, int]], float]:
+    """Phase rows summed over every fit in the stream (for --jsonl/--diff)."""
+    merged: Dict[str, List[float]] = {}
+    for fit_events in fits.values():
+        for name, us, count in fit_phase_rows(fit_events)[0]:
+            slot = merged.setdefault(name, [0.0, 0])
+            slot[0] += us
+            slot[1] += count
+    rows = sorted(
+        ((n, v[0], int(v[1])) for n, v in merged.items()), key=lambda r: -r[1]
+    )
+    return rows, sum(r[1] for r in rows)
+
+
+def render_diff(records_a: List[dict], records_b: List[dict]) -> str:
+    a = {r["op"]: r for r in records_a}
+    b = {r["op"]: r for r in records_b}
+    lines = [f"{'total_ms':>10}  {'other_ms':>10}  {'delta%':>7}  op"]
+    for op in sorted(set(a) | set(b), key=lambda o: -(a.get(o, b.get(o))["total_us"])):
+        ua = a.get(op, {}).get("total_us", 0.0)
+        ub = b.get(op, {}).get("total_us", 0.0)
+        delta = math.inf if ub == 0 else 100.0 * (ua - ub) / ub
+        lines.append(
+            f"{ua / 1e3:>10.3f}  {ub / 1e3:>10.3f}  {delta:>7.1f}  {op}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl_path", help="telemetry JSONL stream to render")
+    ap.add_argument(
+        "--fit",
+        help="only render fits whose fit_id contains this substring",
+    )
+    ap.add_argument(
+        "--jsonl",
+        metavar="PATH",
+        help="write the phase table aggregated over all fits as "
+        '{"op","total_us","count","share"} records',
+    )
+    ap.add_argument(
+        "--diff",
+        metavar="PATH",
+        help="compare against another {op,total_us,...} JSONL (from this "
+        "tool or utils/profiling.py --jsonl)",
+    )
+    args = ap.parse_args(argv)
+    events = load_events(args.jsonl_path)
+    if not events:
+        print(f"no telemetry events found in {args.jsonl_path}")
+        return 1
+    fits = group_fits(events)
+    if args.fit:
+        fits = {k: v for k, v in fits.items() if args.fit in k}
+        if not fits:
+            print(f"no fit_id matching {args.fit!r}")
+            return 1
+    for fit_id in sorted(fits):
+        print(render_fit(fit_id, fits[fit_id]))
+        print()
+    rows, total = aggregate_rows(fits)
+    if args.jsonl:
+        write_jsonl(rows_to_records(rows, total), args.jsonl)
+    if args.diff:
+        other = load_events(args.diff)
+        print("diff vs", args.diff)
+        print(render_diff(rows_to_records(rows, total), other))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
