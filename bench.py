@@ -624,6 +624,13 @@ def inner():
     _, base_fit_s = _timed_fit(est.copy(), X, y)
     _, tel_fit_s = _timed_fit(est.copy(telemetry_path=tel_path), X, y)
     telemetry_overhead_pct = 100.0 * (tel_fit_s - base_fit_s) / base_fit_s
+
+    # numeric-guard overhead: the default fit above runs with the guard on
+    # (on_nonfinite="raise"); an adjacent warm fit with the guard off
+    # isolates the per-chunk non-finite reduction + host sync cost
+    # (budget: <2%, docs/robustness.md)
+    _, off_fit_s = _timed_fit(est.copy(on_nonfinite="off"), X, y)
+    robustness_overhead_pct = 100.0 * (base_fit_s - off_fit_s) / off_fit_s
     telemetry_phase_shares = {}
     try:
         with open(tel_path) as f:
@@ -658,6 +665,7 @@ def inner():
         "hist_precision": hist_precision,
         "telemetry_overhead_pct": round(telemetry_overhead_pct, 2),
         "telemetry_phase_shares": telemetry_phase_shares,
+        "robustness_overhead_pct": round(robustness_overhead_pct, 2),
         "platform": platform,
         "device": str(jax.devices()[0]),
     }

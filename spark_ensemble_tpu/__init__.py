@@ -97,6 +97,17 @@ from spark_ensemble_tpu.telemetry import (
     TelemetryRecorder,
     record_fits,
 )
+from spark_ensemble_tpu import robustness
+from spark_ensemble_tpu.robustness import (
+    ChaosController,
+    ChaosPreemption,
+    ChaosTransientError,
+    NonFiniteError,
+    NumericGuard,
+    RetryPolicy,
+    retry_call,
+    validate_fit_inputs,
+)
 from spark_ensemble_tpu.utils.persist import load
 
 __version__ = "0.1.0"
@@ -156,5 +167,13 @@ __all__ = [
     "MetricsRegistry",
     "TelemetryRecorder",
     "record_fits",
+    "ChaosController",
+    "ChaosPreemption",
+    "ChaosTransientError",
+    "NonFiniteError",
+    "NumericGuard",
+    "RetryPolicy",
+    "retry_call",
+    "validate_fit_inputs",
     "load",
 ]

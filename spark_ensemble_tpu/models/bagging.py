@@ -26,10 +26,12 @@ psum-ed over "data", keeping the single-chip fit_forest fusion win.
 
 from __future__ import annotations
 
+import logging
 import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_ensemble_tpu.models.base import (
@@ -50,6 +52,8 @@ from spark_ensemble_tpu.params import Param, gt_eq, in_array, in_range
 from spark_ensemble_tpu.telemetry.events import FitTelemetry
 from spark_ensemble_tpu.utils.instrumentation import instrumented_fit
 from spark_ensemble_tpu.utils.random import bootstrap_weights, subspace_mask
+
+logger = logging.getLogger("spark_ensemble_tpu")
 
 
 class _BaggingParams(Estimator):
@@ -111,6 +115,60 @@ class _BaggingParams(Estimator):
             ("bagging_member_plan", m, n, d, repl, ratio, sub_ratio), build
         )
         return plan(jax.random.PRNGKey(self.seed), w)
+
+    def _fit_members_guarded(self, fit_all, args, telem, label):
+        """One fused all-member fit under the robustness runtime: a chaos
+        transient-fault hook plus retry/backoff around the dispatch (the
+        bagging analogue of the round-chunk retry in the sequential
+        families — there is exactly one dispatch to protect)."""
+        from spark_ensemble_tpu.robustness.chaos import controller
+        from spark_ensemble_tpu.robustness.retry import retry_call
+
+        ctl = controller()
+        site = f"{label}:fit_all"
+
+        def attempt():
+            ctl.transient(site)
+            return fit_all(*args)
+
+        return retry_call(
+            attempt, policy=self._retry_policy(),
+            op=f"{label}.fit_all", telem=telem,
+        )
+
+    def _drop_bad_members(self, members, member_masks, m, guard):
+        """Apply the ``on_nonfinite`` policy to the fitted member stack:
+        members whose params picked up NaN (chaos ``nan_grad``, or a real
+        numeric blow-up in one bootstrap fit) are TRUE-dropped — bagging
+        prediction averages members with equal weight, so a poisoned member
+        cannot be neutralized by weighting.  ``stop_early`` keeps the prefix
+        before the first bad member; ``skip_round``/``halve_step`` (no step
+        size to halve in one fused fit) keep every finite member.  Returns
+        ``(members, member_masks, kept_count)``."""
+        if guard is None or not guard.active:
+            return members, member_masks, m
+        flags = guard.member_flags(members)
+        if flags is None or not flags.any():
+            return members, member_masks, m
+        first = int(np.flatnonzero(flags)[0])
+        if guard.policy == "raise":
+            guard.raise_error(first, what="member params")
+        if guard.policy == "stop_early":
+            keep = np.arange(first)
+            action = "stop_early"
+        else:
+            keep = np.flatnonzero(~flags)
+            action = "skip_round"
+        if keep.size == 0:
+            # a usable bagging model needs at least one finite member
+            guard.raise_error(first, what="every member's params")
+        guard.record(
+            first, action, members_dropped=int(m - keep.size),
+            members_kept=int(keep.size),
+        )
+        idx = jnp.asarray(keep)
+        members = jax.tree_util.tree_map(lambda x: x[idx], members)
+        return members, member_masks[idx], int(keep.size)
 
     @staticmethod
     def _shard_rows_and_members(mesh: Mesh, base, ctx, y, fit_w, masks, keys):
@@ -214,6 +272,7 @@ class BaggingRegressor(_BaggingParams):
     @instrumented_fit
     def fit(self, X, y, sample_weight=None, mesh=None) -> "BaggingRegressionModel":
         X, y = as_f32(X), as_f32(y)
+        self._validate_fit_inputs(X, y)
         w = resolve_weights(y, sample_weight)
         n, d = X.shape
         # snapshot the base learner: cached round-step closures must not
@@ -236,16 +295,26 @@ class BaggingRegressor(_BaggingParams):
         telem = FitTelemetry.start(self, n=n, d=d)
         telem.phase_mark("setup")
         t_fit = time.perf_counter()
-        members = fit_all(ctx, y, fit_w, masks, keys)
+        label = type(self).__name__
+        members = self._fit_members_guarded(
+            fit_all, (ctx, y, fit_w, masks, keys), telem, label
+        )
         m = int(self.num_base_learners)
         if telem.enabled:
             # every member fits in ONE fused program — all m "rounds" share
             # the fenced program time evenly
             telem.round_chunk(0, m, t_fit, fence=members)
         members = jax.tree_util.tree_map(lambda x: x[:m], members)
+        from spark_ensemble_tpu.robustness.chaos import controller
+
+        members = controller().poison_member_stack(f"{label}:fit_all", members)
+        members, member_masks, m = self._drop_bad_members(
+            members, member_masks, m, self._numeric_guard(telem)
+        )
         model = BaggingRegressionModel(
             params={"members": members, "masks": member_masks},
             num_features=d,
+            num_members=m,
             **self.get_params(),
         )
         telem.finish(model=model, members=m)
@@ -253,6 +322,15 @@ class BaggingRegressor(_BaggingParams):
 
 
 class BaggingRegressionModel(RegressionModel, BaggingRegressor):
+    def __init__(self, num_members=None, **kwargs):
+        super().__init__(**kwargs)
+        # pre-robustness saves carry no num_members: every planned member
+        # was fitted, so the param is the count
+        self.num_members = (
+            int(num_members) if num_members is not None
+            else int(self.num_base_learners)
+        )
+
     def member_predictions(self, X):
         base = self._base()
         fn = self._cached_jit(
@@ -281,6 +359,7 @@ class BaggingClassifier(_BaggingParams):
         self, X, y, sample_weight=None, mesh=None, num_classes=None
     ) -> "BaggingClassificationModel":
         X, y = as_f32(X), as_f32(y)
+        self._validate_fit_inputs(X, y)
         w = resolve_weights(y, sample_weight)
         num_classes = infer_num_classes(y, num_classes)
         n, d = X.shape
@@ -304,17 +383,27 @@ class BaggingClassifier(_BaggingParams):
         telem = FitTelemetry.start(self, n=n, d=d, num_classes=int(num_classes))
         telem.phase_mark("setup")
         t_fit = time.perf_counter()
-        members = fit_all(ctx, y, fit_w, masks, keys)
+        label = type(self).__name__
+        members = self._fit_members_guarded(
+            fit_all, (ctx, y, fit_w, masks, keys), telem, label
+        )
         m = int(self.num_base_learners)
         if telem.enabled:
             # every member fits in ONE fused program — all m "rounds" share
             # the fenced program time evenly
             telem.round_chunk(0, m, t_fit, fence=members)
         members = jax.tree_util.tree_map(lambda x: x[:m], members)
+        from spark_ensemble_tpu.robustness.chaos import controller
+
+        members = controller().poison_member_stack(f"{label}:fit_all", members)
+        members, member_masks, m = self._drop_bad_members(
+            members, member_masks, m, self._numeric_guard(telem)
+        )
         model = BaggingClassificationModel(
             params={"members": members, "masks": member_masks},
             num_features=d,
             num_classes=num_classes,
+            num_members=m,
             **self.get_params(),
         )
         telem.finish(model=model, members=m)
@@ -322,6 +411,14 @@ class BaggingClassifier(_BaggingParams):
 
 
 class BaggingClassificationModel(ClassificationModel, BaggingClassifier):
+    def __init__(self, num_members=None, **kwargs):
+        super().__init__(**kwargs)
+        # pre-robustness saves carry no num_members: see regression model
+        self.num_members = (
+            int(num_members) if num_members is not None
+            else int(self.num_base_learners)
+        )
+
     def member_class_predictions(self, X):
         """Per-member class predictions ``f32[m, n]`` (the reference tests'
         member-agreement/diversity assertions use these,
@@ -356,8 +453,9 @@ class BaggingClassificationModel(ClassificationModel, BaggingClassifier):
 
     def predict_proba(self, X):
         # reference raw2probabilityInPlace scales by 1/numModels
-        # (`BaggingClassifier.scala:285-287`)
-        return self.predict_raw(X) / self.num_base_learners
+        # (`BaggingClassifier.scala:285-287`); numModels is the FITTED
+        # count — the guard may have dropped non-finite members
+        return self.predict_raw(X) / self.num_members
 
     def predict(self, X):
         return jnp.argmax(self.predict_raw(X), axis=-1).astype(jnp.float32)

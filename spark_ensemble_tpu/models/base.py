@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spark_ensemble_tpu.params import Param, Params
+from spark_ensemble_tpu.params import Param, Params, gt_eq, in_array
 from spark_ensemble_tpu.utils.instrumentation import instrumented_fit
 
 
@@ -358,6 +358,12 @@ class CheckpointableParams(Params):
         "telemetry_path",
         "feature_names",
         "scan_chunk",
+        # robustness knobs change failure HANDLING, not round math: a
+        # clean run produces identical rounds under any of them, so
+        # checkpoints stay resumable across policy changes
+        "on_nonfinite",
+        "max_retries",
+        "allow_nan",
     )
 
     def _resume_identity(self):
@@ -400,7 +406,7 @@ class CheckpointableParams(Params):
             [jnp.asarray(st_weights, dtype=jnp.float32)],
         )
 
-    def _checkpointer(self, *shape_parts):
+    def _checkpointer(self, *shape_parts, telem=None):
         from spark_ensemble_tpu.utils.checkpoint import (
             TrainingCheckpointer,
             run_fingerprint,
@@ -414,6 +420,8 @@ class CheckpointableParams(Params):
                 self._resume_identity(),
                 *[int(s) for s in shape_parts],
             ),
+            retry_policy=self._retry_policy(),
+            telem=telem,
         )
 
 
@@ -443,9 +451,64 @@ class Estimator(Params):
         doc="optional column names for X; carried onto fitted models and "
         "re-indexed through feature subspaces (`Utils.scala:42-61`)",
     )
+    on_nonfinite = Param(
+        "raise",
+        in_array(["off", "raise", "skip_round", "halve_step", "stop_early"]),
+        doc="numeric-guard policy when a round produces non-finite outputs "
+        "(NaN/Inf member params, losses, or line-search step sizes): "
+        "'raise' fails fast with NonFiniteError, 'skip_round' drops the "
+        "poisoned round's contribution and keeps training, 'halve_step' "
+        "re-runs the round with a halved step size until finite (families "
+        "without a scalable step degrade to skip), 'stop_early' truncates "
+        "the ensemble to the last good round, 'off' disables the check. "
+        "Detection costs one fused jitted reduction per round chunk "
+        "(docs/robustness.md); not part of any program-cache or "
+        "checkpoint-resume identity",
+    )
+    max_retries = Param(
+        2,
+        gt_eq(0),
+        doc="retries (with exponential backoff + jitter) of a round "
+        "dispatch or checkpoint write that fails with a transient "
+        "RuntimeError/OSError (XLA device errors, flaky filesystems); "
+        "0 disables retry.  Each retry emits a 'retry' telemetry event "
+        "(docs/robustness.md)",
+    )
+    allow_nan = Param(
+        False,
+        doc="skip the fail-fast NaN/Inf validation of X/y at fit() entry; "
+        "by default non-finite inputs raise ValueError instead of "
+        "silently producing a NaN model (docs/robustness.md)",
+    )
 
     def fit(self, X, y, sample_weight=None) -> Model:
         raise NotImplementedError
+
+    # -- robustness runtime hooks (docs/robustness.md) ---------------------
+
+    def _retry_policy(self):
+        """The estimator's retry policy, or ``None`` when retries are off
+        (``retry_call`` treats None as the default policy, so callers gate
+        on max_retries themselves via this returning a 0-retry policy)."""
+        from spark_ensemble_tpu.robustness.retry import RetryPolicy
+
+        return RetryPolicy(max_retries=int(self.max_retries))
+
+    def _numeric_guard(self, telem=None):
+        """A per-fit :class:`NumericGuard` bound to this estimator's
+        ``on_nonfinite`` policy and the fit's telemetry stream."""
+        from spark_ensemble_tpu.robustness.guards import NumericGuard
+
+        return NumericGuard(
+            self.on_nonfinite, family=type(self).__name__, telem=telem
+        )
+
+    def _validate_fit_inputs(self, X, y=None):
+        from spark_ensemble_tpu.robustness.validate import validate_fit_inputs
+
+        validate_fit_inputs(
+            X, y, allow_nan=bool(self.allow_nan), family=type(self).__name__
+        )
 
 
 class BaseLearner(Estimator):
@@ -606,6 +669,7 @@ class BaseLearner(Estimator):
         here, zero per-learner code.  (Padding rows carry weight 0.)"""
         X = as_f32(X)
         y = as_f32(y)
+        self._validate_fit_inputs(X, y)
         w = resolve_weights(y, sample_weight)
         num_classes = (
             infer_num_classes(y, num_classes) if self.is_classifier else None

@@ -153,12 +153,24 @@ class _BoostingParams(CheckpointableParams, Estimator):
         start_i: int,
         ramp: bool = False,
         telem: Optional[FitTelemetry] = None,
+        guard=None,
     ) -> int:
         """Shared chunked round driver for both boosting flavors: chunk
         clamping to checkpoint boundaries, per-chunk key fan-out, host
         replay of the flavor's stopping rules, slice-append of kept rounds,
         and gated periodic saves.  Mutates the chunk lists; returns the
         final round count.
+
+        Robustness (docs/robustness.md): each chunk dispatch runs under
+        retry/backoff for transient ``RuntimeError``s, a ``NumericGuard``
+        checks the chunk's member params (NaN) and weight scalars
+        (non-finite), and recovery rewinds ``bw`` to the chunk start and
+        deterministically replays the clean prefix (same absolute-round
+        ``fold_in`` keys -> identical rounds).  Boosting members are
+        TRUE-dropped on ``skip_round`` — SAMME.R prediction ignores
+        estimator weights, so a zero-weight poisoned member would still
+        vote — and ``halve_step`` degrades to ``skip_round`` (no scalable
+        step size in the boosting round).
 
         ``ramp``: abort-prone flavors (discrete SAMME, Drucker R2 — their
         stopping rules fire routinely on weak learners) dispatch a
@@ -172,6 +184,31 @@ class _BoostingParams(CheckpointableParams, Estimator):
         measured +15% on 10-round CPU stump boosting — for protection the
         probe alone provides where it matters).  ``ramp='off'`` skips the
         probe.  SAMME.R has no error-threshold abort and never probes."""
+        from spark_ensemble_tpu.robustness.chaos import controller
+        from spark_ensemble_tpu.robustness.retry import retry_call
+
+        ctl = controller()
+        retry_policy = self._retry_policy()
+        label = type(self).__name__
+        guard_on = guard is not None and guard.active
+
+        def dispatch(keys, bw_in, i0):
+            # transient-fault surface: device dispatch of the whole chunk.
+            # Chaos faults are at-most-once per site, so the retry (and the
+            # recovery replay below) always sees a clean re-run.
+            site = f"{label}:round:{i0}"
+
+            def attempt():
+                ctl.transient(site)
+                return run_chunk(keys, bw_in)
+
+            params_c, est_ws, sum_bws, bw_out, extras = retry_call(
+                attempt, policy=retry_policy,
+                op=f"{label}.round_chunk", telem=telem,
+            )
+            params_c = ctl.poison_member_stack(site, params_c)
+            return params_c, est_ws, sum_bws, bw_out, extras
+
         i = start_i
         chunk = max(int(self.scan_chunk), 1)
         # a checkpoint resume starts at the full chunk: start_i kept rounds
@@ -188,29 +225,67 @@ class _BoostingParams(CheckpointableParams, Estimator):
                 jnp.arange(i, i + c)
             )
             t_chunk = time.perf_counter()
-            params_c, est_ws, sum_bws, bw, extras = run_chunk(keys, bw)
-            sum_bws = np.asarray(sum_bws)
-            kept, stop = replay(extras, sum_bws, c, i)
-            if telem is not None and telem.enabled:
-                # classifier extras = per-round errs; Drucker extras =
-                # (max_errs, est_errs) — the estimator error is the loss
-                losses = extras[1] if isinstance(extras, tuple) else extras
-                telem.round_chunk(
-                    i, kept, t_chunk,
-                    fence=(params_c, est_ws),
-                    losses=None if losses is None else np.asarray(losses)[:kept],
-                    step_sizes=np.asarray(est_ws)[:kept] if kept > 0 else None,
-                    divisor=c,
+            bw_prev = bw
+            params_c, est_ws, sum_bws, bw, extras = dispatch(keys, bw, i)
+            skip_after = 0  # guard-dropped rounds: consume the index, no member
+            halt = False
+            bad = (
+                guard.first_nonfinite(params_c, est_ws, sum_bws, extras)
+                if guard_on
+                else None
+            )
+            if bad is not None:
+                if guard.policy == "raise":
+                    guard.raise_error(i + bad)
+                action = (
+                    "stop_early" if guard.policy == "stop_early"
+                    else "skip_round"
                 )
+                extra = (
+                    {"degraded_from": "halve_step"}
+                    if guard.policy == "halve_step"
+                    else {}
+                )
+                guard.record(i + bad, action, member_dropped=True, **extra)
+                # rewind to the chunk-start weights and deterministically
+                # replay the clean prefix (same keys -> same rounds)
+                bw = bw_prev
+                c = bad
+                if c > 0:
+                    params_c, est_ws, sum_bws, bw, extras = dispatch(
+                        keys[:c], bw, i
+                    )
+                if action == "stop_early":
+                    halt = True
+                else:
+                    skip_after = 1
+            if c > 0:
+                sum_bws = np.asarray(sum_bws)
+                kept, stop = replay(extras, sum_bws, c, i)
+                if telem is not None and telem.enabled:
+                    # classifier extras = per-round errs; Drucker extras =
+                    # (max_errs, est_errs) — the estimator error is the loss
+                    losses = extras[1] if isinstance(extras, tuple) else extras
+                    telem.round_chunk(
+                        i, kept, t_chunk,
+                        fence=(params_c, est_ws),
+                        losses=None if losses is None else np.asarray(losses)[:kept],
+                        step_sizes=np.asarray(est_ws)[:kept] if kept > 0 else None,
+                        divisor=c,
+                    )
+                if not stop:
+                    # sequential loop guard for the NEXT round: weight mass
+                    # after this chunk's last kept round must stay positive
+                    stop = float(sum_bws[c - 1]) <= 0
+                if kept > 0:
+                    members_chunks.append(slice_pytree(params_c, kept))
+                    weights_chunks.append(est_ws[:kept])
+                i += kept
+            if halt:
+                stop = True
             if not stop:
-                # sequential loop guard for the NEXT round: weight mass
-                # after this chunk's last kept round must stay positive
-                stop = float(sum_bws[c - 1]) <= 0
-            if kept > 0:
-                members_chunks.append(slice_pytree(params_c, kept))
-                weights_chunks.append(est_ws[:kept])
-            i += kept
-            if not stop and ckpt.should_save(i - 1):
+                i += skip_after
+            if not stop and i > start_i and ckpt.should_save(i - 1):
                 ckpt.save(
                     i - 1,
                     {
@@ -220,6 +295,8 @@ class _BoostingParams(CheckpointableParams, Estimator):
                         "est_weights": concat_pytrees(weights_chunks),
                     },
                 )
+            if not stop:
+                ctl.preempt(f"{label}:after_round:{i}")
         # join the in-flight async save before the model is assembled
         ckpt.wait()
         return i
@@ -249,6 +326,7 @@ class BoostingClassifier(_BoostingParams):
         executor-side ``treeAggregate`` round reductions
         (`BoostingClassifier.scala:175,235-242`)."""
         X, y = as_f32(X), as_f32(y)
+        self._validate_fit_inputs(X, y)
         w = resolve_weights(y, sample_weight)
         num_classes = infer_num_classes(y, num_classes)
         n, d = X.shape
@@ -383,7 +461,7 @@ class BoostingClassifier(_BoostingParams):
         # n_pad is part of the resume identity: a checkpointed `bw` is padded
         # to the mesh's data-axis size, so a resume under a different mesh
         # must start fresh rather than load a wrong-length weight vector
-        ckpt = self._checkpointer(n, d, num_classes, n_pad)
+        ckpt = self._checkpointer(n, d, num_classes, n_pad, telem=telem)
         resumed = ckpt.load_latest()
         if resumed is not None:
             last_round, st = resumed
@@ -397,11 +475,19 @@ class BoostingClassifier(_BoostingParams):
                 st, weights_key="est_weights"
             )
             logger.info("BoostingClassifier resuming from round %d", i)
+            detail = ckpt.last_load_detail or {}
+            telem.emit(
+                "resume_from_checkpoint",
+                round=i,
+                source=detail.get("source", "latest"),
+                fallback=bool(detail.get("fallback", False)),
+            )
 
         telem.phase_mark("setup")
         self._drive_boosting_rounds(
             ckpt, bw, root, members_chunks, weights_chunks, run_chunk, replay,
             i, ramp=(algorithm == "discrete"), telem=telem,
+            guard=self._numeric_guard(telem),
         )
         ckpt.delete()
         num_members = int(sum(wc.shape[0] for wc in weights_chunks))
@@ -501,6 +587,7 @@ class BoostingRegressor(_BoostingParams):
         `BoostingRegressor.scala:232-249`).  Padding rows are excluded from
         ``maxError`` by a validity mask (their weight is already 0)."""
         X, y = as_f32(X), as_f32(y)
+        self._validate_fit_inputs(X, y)
         w = resolve_weights(y, sample_weight)
         n, d = X.shape
         instr = Instrumentation("BoostingRegressor.fit")
@@ -647,7 +734,7 @@ class BoostingRegressor(_BoostingParams):
         weights_chunks: List[Any] = []
         i = 0
         # n_pad in the fingerprint: see BoostingClassifier.fit
-        ckpt = self._checkpointer(n, d, n_pad)
+        ckpt = self._checkpointer(n, d, n_pad, telem=telem)
         resumed = ckpt.load_latest()
         if resumed is not None:
             last_round, st = resumed
@@ -661,11 +748,19 @@ class BoostingRegressor(_BoostingParams):
                 st, weights_key="est_weights"
             )
             logger.info("BoostingRegressor resuming from round %d", i)
+            detail = ckpt.last_load_detail or {}
+            telem.emit(
+                "resume_from_checkpoint",
+                round=i,
+                source=detail.get("source", "latest"),
+                fallback=bool(detail.get("fallback", False)),
+            )
 
         telem.phase_mark("setup")
         self._drive_boosting_rounds(
             ckpt, bw, root, members_chunks, weights_chunks, run_chunk, replay,
             i, ramp=True, telem=telem,
+            guard=self._numeric_guard(telem),
         )
         ckpt.delete()
         num_members = int(sum(wc.shape[0] for wc in weights_chunks))

@@ -287,7 +287,7 @@ class _GBMParams(CheckpointableParams, Estimator):
         ckpt,
         members_chunks: List[Any],
         weights_chunks: List[Any],
-        run_chunk,  # (sl: slice) -> (params [c,...], weights [c,...], errs|None)
+        run_chunk,  # (sl, step_scale) -> (params [c,...], weights [c,...], errs|None)
         save_state,  # (round_idx, v, best) -> None  (must self-gate)
         label: str,
         i: int,
@@ -295,6 +295,9 @@ class _GBMParams(CheckpointableParams, Estimator):
         best: float,
         val_history: Optional[List[float]] = None,  # mutated: per-round val losses
         telem: Optional[FitTelemetry] = None,
+        guard=None,  # NumericGuard | None
+        snapshot=None,  # () -> opaque copy of the carried prediction state
+        restore=None,  # (snap) -> None; rewind the carry to chunk start
     ):
         """The shared round-loop driver: scan-chunked dispatch (one program
         per `scan_chunk` rounds, single-chip AND under a mesh — validation
@@ -303,17 +306,37 @@ class _GBMParams(CheckpointableParams, Estimator):
         identical for both GBM flavors.  ``run_chunk`` owns the
         prediction-state updates (via closure); extra members computed past a
         mid-chunk validation stop are trimmed by the caller's final
-        ``keep = i - v`` slice."""
+        ``keep = i - v`` slice.
+
+        Robustness (docs/robustness.md): each chunk dispatch runs inside the
+        retry/backoff layer (transient RuntimeError/XLA errors re-dispatch
+        the SAME pure program), and when the numeric guard flags a round the
+        carry is rewound to the chunk start, the clean prefix is replayed
+        (bit-identical: same absolute round keys), and the poisoned round is
+        raised / skipped / step-halved / truncated per ``on_nonfinite``."""
+        from spark_ensemble_tpu.robustness.chaos import controller
+        from spark_ensemble_tpu.robustness.retry import retry_call
+
         chunk = max(int(self.scan_chunk), 1)
-        while i < self.num_base_learners and v < self.num_rounds:
-            c = min(chunk, self.num_base_learners - i)
-            if ckpt.enabled:
-                # end the chunk exactly on the next save boundary: keeps
-                # periodic saves firing at any resume offset, including a
-                # resume under a CHANGED checkpoint_interval
-                c = min(c, ckpt.rounds_until_save(i))
-            t_chunk = time.perf_counter()
-            params_c, weights_c, errs = run_chunk(slice(i, i + c))
+        retry_policy = self._retry_policy()
+        ctl = controller()
+        guard_on = guard is not None and guard.active
+
+        def dispatch(sl, step_scale=1.0):
+            site = f"{label}:round:{sl.start}"
+
+            def attempt():
+                ctl.transient(site)
+                return run_chunk(sl, step_scale)
+
+            params_c, weights_c, errs = retry_call(
+                attempt, retry_policy, op=f"{label}.round_chunk", telem=telem
+            )
+            weights_c = ctl.poison_array(site, weights_c)
+            return params_c, weights_c, errs
+
+        def process(i, c, t_chunk, params_c, weights_c, errs, v, best):
+            """One clean chunk's bookkeeping -> (i, v, best, stopped)."""
             if telem is not None and telem.enabled:
                 # fence on the chunk outputs before reading the clock:
                 # dispatch is async and an unfenced stamp times the enqueue
@@ -343,6 +366,108 @@ class _GBMParams(CheckpointableParams, Estimator):
             if not stopped:
                 i += c
                 save_state(i - 1, v, best)
+            return i, v, best, stopped
+
+        def part(tree, lo, hi):
+            return jax.tree_util.tree_map(lambda x: x[lo:hi], tree)
+
+        def sanitize(tree):
+            return jax.tree_util.tree_map(
+                lambda x: jnp.nan_to_num(x, nan=0.0, posinf=0.0, neginf=0.0)
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+                else x,
+                tree,
+            )
+
+        def recover(i0, c, bad, snap, params_c, weights_c, errs, v, best):
+            """Apply ``on_nonfinite`` to a chunk whose first poisoned round
+            is chunk-relative index ``bad`` -> (i, v, best, halt)."""
+            rnd = i0 + bad
+            if guard.policy == "raise" or snap is None:
+                guard.raise_error(rnd)
+            if guard.policy == "stop_early":
+                # keep the clean prefix (its members/weights came back
+                # finite; the poisoned carry is never used again — the
+                # final model is assembled from members, not the carry)
+                guard.record(rnd, "stop_early")
+                i = i0
+                if bad > 0:
+                    i, v, best, _ = process(
+                        i0, bad, time.perf_counter(),
+                        part(params_c, 0, bad), part(weights_c, 0, bad),
+                        None if errs is None else errs[:bad],
+                        v, best,
+                    )
+                return i, v, best, True
+            # skip_round / halve_step: rewind the carry and replay the clean
+            # prefix (same absolute rounds -> same fold_in keys -> identical
+            # outputs; injected faults fire at most once per site, and real
+            # transient faults are gone by construction)
+            restore(snap)
+            i = i0
+            if bad > 0:
+                t0 = time.perf_counter()
+                p_pre, w_pre, e_pre = dispatch(slice(i0, i0 + bad))
+                i, v, best, stopped = process(
+                    i0, bad, t0, p_pre, w_pre, e_pre, v, best
+                )
+                if stopped:
+                    return i, v, best, False
+            if guard.policy == "halve_step":
+                for h in range(1, guard.max_halvings + 1):
+                    scale = 0.5 ** h
+                    snap2 = snapshot()
+                    t0 = time.perf_counter()
+                    p1, w1, e1 = dispatch(slice(i, i + 1), step_scale=scale)
+                    if guard.first_nonfinite(p1, w1, e1) is None:
+                        guard.record(i, "halve_step", step_scale=scale)
+                        i, v, best, _ = process(i, 1, t0, p1, w1, e1, v, best)
+                        return i, v, best, False
+                    restore(snap2)
+                # not recoverable by damping: fall through to a skip
+            # skip: re-run the round at step_scale=0 — the carried
+            # prediction state advances by EXACTLY zero (the chunk program
+            # hard-zeroes the contribution, so even NaN directions cannot
+            # leak through 0*NaN) while keys/masks/checkpoint cadence stay
+            # aligned to absolute round indices
+            guard.record(i, "skip_round")
+            t0 = time.perf_counter()
+            p1, w1, e1 = dispatch(slice(i, i + 1), step_scale=0.0)
+            # the member fit itself may be the non-finite source: store a
+            # sanitized zero-weight copy so predict never sees 0 * NaN
+            p1, w1 = sanitize(p1), sanitize(w1)
+            e1 = None if e1 is None else jnp.nan_to_num(e1)
+            i, v, best, _ = process(i, 1, t0, p1, w1, e1, v, best)
+            return i, v, best, False
+
+        halt = False
+        while not halt and i < self.num_base_learners and v < self.num_rounds:
+            c = min(chunk, self.num_base_learners - i)
+            if ckpt.enabled:
+                # end the chunk exactly on the next save boundary: keeps
+                # periodic saves firing at any resume offset, including a
+                # resume under a CHANGED checkpoint_interval
+                c = min(c, ckpt.rounds_until_save(i))
+            snap = snapshot() if (guard_on and snapshot is not None) else None
+            t_chunk = time.perf_counter()
+            params_c, weights_c, errs = dispatch(slice(i, i + c))
+            bad = (
+                guard.first_nonfinite(params_c, weights_c, errs)
+                if guard_on
+                else None
+            )
+            if bad is None:
+                i, v, best, _ = process(
+                    i, c, t_chunk, params_c, weights_c, errs, v, best
+                )
+            else:
+                i, v, best, halt = recover(
+                    i, c, bad, snap, params_c, weights_c, errs, v, best
+                )
+            # chaos: a mid-training preemption lands here — after the
+            # chunk's periodic save, so kill-and-resume tests exercise a
+            # real checkpoint boundary
+            ctl.preempt(f"{label}:after_round:{i}")
         # the loop must not end with a dangling background write: join the
         # in-flight async save (and surface its failure) before the model
         # is assembled
@@ -561,6 +686,7 @@ class GBMRegressor(_GBMParams):
         """
         X = as_f32(X)
         y = as_f32(y)
+        self._validate_fit_inputs(X, y)
         w_all = resolve_weights(y, sample_weight)
         if validation_indicator is not None:
             vi = np.asarray(validation_indicator, bool)
@@ -631,7 +757,8 @@ class GBMRegressor(_GBMParams):
         # all data flows through arguments so the jitted programs are
         # reusable across fits with the same config (no per-fit retrace)
         def make_round_core():
-            def round_core(ctx, X, bag_w, key, mask, pred, delta, y, w):
+            def round_core(ctx, X, bag_w, key, mask, pred, delta, y, w,
+                           scale):
                 loss = make_loss(delta)
                 y_enc = loss.encode_label(y)
                 labels, fit_w, bag_w = _pseudo_residuals_and_weights(
@@ -677,8 +804,15 @@ class GBMRegressor(_GBMParams):
                     )
                 else:
                     alpha_opt = jnp.asarray(1.0, jnp.float32)
-                weight = lr * alpha_opt
-                new_pred = pred + weight * direction
+                # `scale` is the numeric guard's step damper (1.0 on the
+                # clean path — a multiplicative identity, bit-exact).  At
+                # scale == 0 (skip_round replay) the contribution is
+                # HARD-zeroed so a NaN direction/step cannot leak through
+                # 0 * NaN into the carried prediction state.
+                weight = jnp.where(scale > 0, lr * alpha_opt * scale, 0.0)
+                new_pred = pred + jnp.where(
+                    scale > 0, weight * direction, 0.0
+                )
                 return params, weight, new_pred
 
             return round_core
@@ -691,20 +825,24 @@ class GBMRegressor(_GBMParams):
             round_core = make_round_core()
 
             def chunk(ctx, X, y, w, valid_w, pred, pred_val, delta,
-                      X_val_a, y_val_a, bag_ws, keys, masks):
+                      X_val_a, y_val_a, bag_ws, keys, masks, scales):
                 def body(carry, xs):
                     pred, pred_val, delta = carry
-                    bag_w, key, mask = xs
+                    bag_w, key, mask, scale = xs
                     if huber:
                         delta = weighted_quantile(
                             jnp.abs(y - pred), alpha_q, weights=valid_w
                         )
                     params, weight, new_pred = round_core(
-                        ctx, X, bag_w, key, mask, pred, delta, y, w
+                        ctx, X, bag_w, key, mask, pred, delta, y, w, scale
                     )
                     if with_validation:
                         dir_val = base.predict_fn(params, X_val_a)
-                        new_pred_val = pred_val + weight * dir_val
+                        # same hard-zero-at-scale-0 guard as the train-side
+                        # update: 0 * NaN must not poison the val carry
+                        new_pred_val = pred_val + jnp.where(
+                            scale > 0, weight * dir_val, 0.0
+                        )
                         l = make_loss(delta)
                         err = jnp.mean(
                             l.loss(l.encode_label(y_val_a), new_pred_val[:, None])
@@ -715,7 +853,10 @@ class GBMRegressor(_GBMParams):
                     return (new_pred, new_pred_val, delta), (params, weight, err)
 
                 (pred, pred_val, delta), (params_all, weights_all, errs) = (
-                    jax.lax.scan(body, (pred, pred_val, delta), (bag_ws, keys, masks))
+                    jax.lax.scan(
+                        body, (pred, pred_val, delta),
+                        (bag_ws, keys, masks, scales),
+                    )
                 )
                 return params_all, weights_all, errs, pred, pred_val, delta
 
@@ -732,10 +873,11 @@ class GBMRegressor(_GBMParams):
             round_core = make_round_core()
 
             def chunk(ctx, X, y, w, valid_w, pred, pred_val, delta,
-                      X_val_a, y_val_a, valid_val, bag_ws, keys, masks):
+                      X_val_a, y_val_a, valid_val, bag_ws, keys, masks,
+                      scales):
                 def body(carry, xs):
                     pred, pred_val, delta = carry
-                    bag_w, key, mask = xs
+                    bag_w, key, mask, scale = xs
                     if huber:
                         # psum-ed histogram refinement inside the quantile
                         # (no all_gather): identical global delta on every
@@ -745,11 +887,13 @@ class GBMRegressor(_GBMParams):
                             axis_name=ax,
                         )
                     params, weight, new_pred = round_core(
-                        ctx, X, bag_w, key, mask, pred, delta, y, w
+                        ctx, X, bag_w, key, mask, pred, delta, y, w, scale
                     )
                     if with_validation:
                         dir_val = base.predict_fn(params, X_val_a)
-                        new_pred_val = pred_val + weight * dir_val
+                        new_pred_val = pred_val + jnp.where(
+                            scale > 0, weight * dir_val, 0.0
+                        )
                         l = make_loss(delta)
                         le = l.loss(
                             l.encode_label(y_val_a), new_pred_val[:, None]
@@ -766,7 +910,8 @@ class GBMRegressor(_GBMParams):
 
                 (pred, pred_val, delta), (params_all, weights_all, errs) = (
                     jax.lax.scan(
-                        body, (pred, pred_val, delta), (bag_ws, keys, masks)
+                        body, (pred, pred_val, delta),
+                        (bag_ws, keys, masks, scales),
                     )
                 )
                 return params_all, weights_all, errs, pred, pred_val, delta
@@ -790,6 +935,7 @@ class GBMRegressor(_GBMParams):
                         P(None, ax),  # bag_ws [c, n_pad]
                         P(),  # keys [c, 2]
                         P(),  # masks [c, d]
+                        P(),  # scales [c]
                     ),
                     out_specs=(P(), P(), P(), P(ax), P(ax), P()),
                     check_vma=False,
@@ -863,10 +1009,17 @@ class GBMRegressor(_GBMParams):
         # `pred_val` are padded to the mesh's data-axis size, so a resume
         # under a different mesh (different padding) must start fresh rather
         # than load wrong-length prediction state
-        ckpt = self._checkpointer(n, d, n_pad, nv_pad)
+        ckpt = self._checkpointer(n, d, n_pad, nv_pad, telem=telem)
         resumed = ckpt.load_latest()
         if resumed is not None:
             last_round, st = resumed
+            detail = ckpt.last_load_detail or {}
+            telem.emit(
+                "resume_from_checkpoint",
+                round=last_round + 1,
+                source=detail.get("source", "latest"),
+                fallback=bool(detail.get("fallback", False)),
+            )
             i, v, best = last_round + 1, int(st["v"]), float(st["best"])
             val_history[:] = [float(x) for x in np.asarray(st.get("val_hist", []))]
             pred = jnp.asarray(st["pred"])
@@ -905,8 +1058,11 @@ class GBMRegressor(_GBMParams):
                 },
             )
 
-        def run_chunk(sl):
+        def run_chunk(sl, step_scale=1.0):
             nonlocal pred, pred_val, delta
+            scales = jnp.full(
+                (sl.stop - sl.start,), step_scale, jnp.float32
+            )
             if mesh is not None:
                 params_c, weights_c, errs, pred, pred_val_new, delta = (
                     chunk_step(
@@ -917,6 +1073,7 @@ class GBMRegressor(_GBMParams):
                         y_val if with_validation else val_dummy,
                         valid_val,
                         bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
+                        scales,
                     )
                 )
             else:
@@ -928,17 +1085,27 @@ class GBMRegressor(_GBMParams):
                         X_val if with_validation else val_dummy,
                         y_val if with_validation else val_dummy,
                         bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
+                        scales,
                     )
                 )
             if with_validation:
                 pred_val = pred_val_new
             return params_c, weights_c, errs if with_validation else None
 
+        def snapshot():
+            return pred, pred_val, delta
+
+        def restore(snap):
+            nonlocal pred, pred_val, delta
+            pred, pred_val, delta = snap
+
         telem.phase_mark("setup")
         i, v, best = self._drive_rounds(
             ckpt, members_chunks, weights_chunks,
             run_chunk, save_state, "GBMRegressor", i, v, best,
             val_history=val_history, telem=telem,
+            guard=self._numeric_guard(telem),
+            snapshot=snapshot, restore=restore,
         )
         ckpt.delete()
 
@@ -1052,6 +1219,7 @@ class GBMClassifier(_GBMParams):
         (`GBMClassifier.scala:344-355,377-411`)."""
         X = as_f32(X)
         y = as_f32(y)
+        self._validate_fit_inputs(X, y)
         w_all = resolve_weights(y, sample_weight)
         # validate over the FULL label set (train + validation) so a
         # validation fold missing the top class cannot shrink the model
@@ -1152,7 +1320,8 @@ class GBMClassifier(_GBMParams):
         def make_round_core():
             k_local = dim_blk // member_size
 
-            def round_core(ctx, X, y_enc, w, bag_w, key, mask, pred, alpha_ws):
+            def round_core(ctx, X, y_enc, w, bag_w, key, mask, pred,
+                           alpha_ws, scale):
                 labels, fit_w, bag_w = _pseudo_residuals_and_weights(
                     loss, updates, y_enc, pred, bag_w, w, axis_name=ax,
                     goss=goss, goss_key=jax.random.fold_in(key, 7),
@@ -1220,9 +1389,22 @@ class GBMClassifier(_GBMParams):
                     )
                 else:
                     alpha_opt = jnp.ones((dim,), jnp.float32)
-                weight = lr * alpha_opt
-                new_pred = pred + weight[None, :] * directions
-                return params, weight, new_pred, alpha_opt
+                # `scale` is the numeric guard's step damper (1.0 on the
+                # clean path — multiplicative identity).  At scale == 0 the
+                # contribution is HARD-zeroed (0 * NaN must not leak), and
+                # the warm-start carry resets to ones when the line search
+                # itself went non-finite so later rounds restart clean.
+                weight = jnp.where(
+                    scale > 0, lr * alpha_opt * scale, 0.0
+                )
+                new_pred = pred + jnp.where(
+                    scale > 0, weight[None, :] * directions, 0.0
+                )
+                alpha_carry = jnp.where(
+                    jnp.isfinite(alpha_opt), alpha_opt,
+                    jnp.ones_like(alpha_opt),
+                )
+                return params, weight, new_pred, alpha_carry
 
             return round_core
 
@@ -1234,18 +1416,21 @@ class GBMClassifier(_GBMParams):
             round_core = make_round_core()
 
             def chunk(ctx, X, y_enc, w, pred, pred_val, alpha_ws, X_val_a,
-                      y_enc_val_a, bag_ws, keys, masks):
+                      y_enc_val_a, bag_ws, keys, masks, scales):
                 def body(carry, xs):
                     pred, pred_val, alpha_ws = carry
-                    bag_w, key, mask = xs
+                    bag_w, key, mask, scale = xs
                     params, weight, new_pred, alpha_ws = round_core(
-                        ctx, X, y_enc, w, bag_w, key, mask, pred, alpha_ws
+                        ctx, X, y_enc, w, bag_w, key, mask, pred, alpha_ws,
+                        scale,
                     )
                     if with_validation:
                         dirs_val = jax.vmap(
                             lambda p: base.predict_fn(p, X_val_a)
                         )(params).T
-                        new_pred_val = pred_val + weight[None, :] * dirs_val
+                        new_pred_val = pred_val + jnp.where(
+                            scale > 0, weight[None, :] * dirs_val, 0.0
+                        )
                         err = jnp.mean(loss.loss(y_enc_val_a, new_pred_val))
                     else:
                         new_pred_val = pred_val
@@ -1254,7 +1439,8 @@ class GBMClassifier(_GBMParams):
 
                 (pred, pred_val, alpha_ws), (params_all, weights_all, errs) = (
                     jax.lax.scan(
-                        body, (pred, pred_val, alpha_ws), (bag_ws, keys, masks)
+                        body, (pred, pred_val, alpha_ws),
+                        (bag_ws, keys, masks, scales),
                     )
                 )
                 return params_all, weights_all, errs, pred, pred_val, alpha_ws
@@ -1272,12 +1458,13 @@ class GBMClassifier(_GBMParams):
             round_core = make_round_core()
 
             def chunk(ctx, X, y_enc, w, pred, pred_val, alpha_ws, X_val_a,
-                      y_enc_val_a, valid_val, bag_ws, keys, masks):
+                      y_enc_val_a, valid_val, bag_ws, keys, masks, scales):
                 def body(carry, xs):
                     pred, pred_val, alpha_ws = carry
-                    bag_w, key, mask = xs
+                    bag_w, key, mask, scale = xs
                     params, weight, new_pred, alpha_ws = round_core(
-                        ctx, X, y_enc, w, bag_w, key, mask, pred, alpha_ws
+                        ctx, X, y_enc, w, bag_w, key, mask, pred, alpha_ws,
+                        scale,
                     )
                     if with_validation:
                         dirs_val = jax.vmap(
@@ -1287,7 +1474,9 @@ class GBMClassifier(_GBMParams):
                             dirs_val = jax.lax.all_gather(
                                 dirs_val, "member", axis=1, tiled=True
                             )[:, :dim]
-                        new_pred_val = pred_val + weight[None, :] * dirs_val
+                        new_pred_val = pred_val + jnp.where(
+                            scale > 0, weight[None, :] * dirs_val, 0.0
+                        )
                         le = jnp.reshape(
                             loss.loss(y_enc_val_a, new_pred_val), (-1,)
                         )
@@ -1301,7 +1490,8 @@ class GBMClassifier(_GBMParams):
 
                 (pred, pred_val, alpha_ws), (params_all, weights_all, errs) = (
                     jax.lax.scan(
-                        body, (pred, pred_val, alpha_ws), (bag_ws, keys, masks)
+                        body, (pred, pred_val, alpha_ws),
+                        (bag_ws, keys, masks, scales),
                     )
                 )
                 return params_all, weights_all, errs, pred, pred_val, alpha_ws
@@ -1324,6 +1514,7 @@ class GBMClassifier(_GBMParams):
                         P(None, ax),  # bag_ws [c, n_pad]
                         P(),  # keys [c, 2]
                         P(),  # masks [c, d]
+                        P(),  # scales [c]
                     ),
                     out_specs=(
                         P(None, "member") if member_size > 1 else P(),
@@ -1402,10 +1593,17 @@ class GBMClassifier(_GBMParams):
 
         # n_pad AND nv_pad in the identity: see GBMRegressor — padded
         # `pred`/`pred_val` must not be resumed under a different topology
-        ckpt = self._checkpointer(n, d, num_classes, n_pad, nv_pad)
+        ckpt = self._checkpointer(n, d, num_classes, n_pad, nv_pad, telem=telem)
         resumed = ckpt.load_latest()
         if resumed is not None:
             last_round, st = resumed
+            detail = ckpt.last_load_detail or {}
+            telem.emit(
+                "resume_from_checkpoint",
+                round=last_round + 1,
+                source=detail.get("source", "latest"),
+                fallback=bool(detail.get("fallback", False)),
+            )
             i, v, best = last_round + 1, int(st["v"]), float(st["best"])
             val_history[:] = [float(x) for x in np.asarray(st.get("val_hist", []))]
             if "alpha_ws" in st:
@@ -1445,8 +1643,11 @@ class GBMClassifier(_GBMParams):
                 },
             )
 
-        def run_chunk(sl):
+        def run_chunk(sl, step_scale=1.0):
             nonlocal pred, pred_val, alpha_ws
+            scales = jnp.full(
+                (sl.stop - sl.start,), step_scale, jnp.float32
+            )
             if mesh is not None:
                 params_c, weights_c, errs, pred, pred_val_new, alpha_ws = (
                     chunk_step(
@@ -1457,6 +1658,7 @@ class GBMClassifier(_GBMParams):
                         y_enc_val if with_validation else val_dummy2,
                         valid_val,
                         bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
+                        scales,
                     )
                 )
                 if dim_blk != dim:
@@ -1474,11 +1676,19 @@ class GBMClassifier(_GBMParams):
                         X_val if with_validation else val_dummy,
                         y_enc_val if with_validation else val_dummy,
                         bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
+                        scales,
                     )
                 )
             if with_validation:
                 pred_val = pred_val_new
             return params_c, weights_c, errs if with_validation else None
+
+        def snapshot():
+            return pred, pred_val, alpha_ws
+
+        def restore(snap):
+            nonlocal pred, pred_val, alpha_ws
+            pred, pred_val, alpha_ws = snap
 
         telem.phase_mark("setup")
         if telem.enabled and telem.phases_enabled() and mesh is None:
@@ -1492,6 +1702,8 @@ class GBMClassifier(_GBMParams):
             ckpt, members_chunks, weights_chunks,
             run_chunk, save_state, "GBMClassifier", i, v, best,
             val_history=val_history, telem=telem,
+            guard=self._numeric_guard(telem),
+            snapshot=snapshot, restore=restore,
         )
         ckpt.delete()
 

@@ -93,6 +93,12 @@ class _StackingParams(Estimator):
         ``parallelism > 1`` so dispatch threads overlap the per-device
         executions; without it devices still pipeline dispatch-by-dispatch.
         """
+        from spark_ensemble_tpu.robustness.chaos import controller
+        from spark_ensemble_tpu.robustness.retry import retry_call
+
+        ctl = controller()
+        retry_policy = self._retry_policy()
+        label = type(self).__name__
         # only THIS process's devices are bindable via jax.default_device;
         # on a multi-host pod each host round-robins over its own slice of
         # the mesh (the fits themselves are single-device programs)
@@ -122,15 +128,31 @@ class _StackingParams(Estimator):
                     )
                 return base.fit(X, y, sample_weight=sw)
 
+            site = f"{label}:member:{idx}"
+
+            def attempt():
+                ctl.transient(site)
+                return run()
+
+            def guarded_run():
+                # per-member transient-fault surface: one member's device
+                # dying must not kill the other concurrent member fits
+                return retry_call(
+                    attempt, policy=retry_policy,
+                    op=f"{label}.member_fit", telem=telem,
+                )
+
             t0 = time.perf_counter()
             if device is None:
-                model = run()
+                model = guarded_run()
             else:
                 # jax.default_device is thread-local: every array this fit
                 # creates (and thus every program it dispatches) binds to
                 # this member's device
                 with jax.default_device(device):
-                    model = run()
+                    model = guarded_run()
+            if getattr(model, "params", None) is not None:
+                model.params = ctl.poison_tree(site, model.params)
             if telem is not None and telem.enabled:
                 # fence before stamping: the member fit returns with work
                 # still in flight (with parallelism>1 member durations
@@ -153,6 +175,54 @@ class _StackingParams(Estimator):
                 return list(ex.map(fit_one, jobs))
         return [fit_one(j) for j in jobs]
 
+    def _drop_bad_base_models(self, models, guard):
+        """Apply ``on_nonfinite`` to the fitted level-0 members: a member
+        whose params picked up NaN is dropped (the stacker then trains on
+        the surviving members' meta-features only — the model's prediction
+        path uses the same member list, so layouts stay consistent).
+        ``stop_early`` keeps the prefix before the first bad member;
+        ``skip_round``/``halve_step`` keep every finite member; at least
+        one member must survive."""
+        if guard is None or not guard.active:
+            return models
+        from spark_ensemble_tpu.robustness.guards import tree_any_nan
+
+        bad = [
+            i for i, m in enumerate(models)
+            if tree_any_nan(getattr(m, "params", None))
+        ]
+        if not bad:
+            return models
+        first = bad[0]
+        if guard.policy == "raise":
+            guard.raise_error(first, what="base model params")
+        if guard.policy == "stop_early":
+            kept = models[:first]
+            action = "stop_early"
+        else:
+            bad_set = set(bad)
+            kept = [m for i, m in enumerate(models) if i not in bad_set]
+            action = "skip_round"
+        if not kept:
+            guard.raise_error(first, what="every base model's params")
+        guard.record(
+            first, action,
+            members_dropped=len(models) - len(kept),
+            members_kept=len(kept),
+        )
+        return kept
+
+    def _check_stacker(self, stack_model, n_members, guard):
+        """The level-1 meta-learner has no drop/skip fallback — a non-finite
+        stacker is always fatal when the guard is active (every prediction
+        routes through it)."""
+        if guard is None or not guard.active:
+            return
+        from spark_ensemble_tpu.robustness.guards import tree_any_nan
+
+        if tree_any_nan(getattr(stack_model, "params", None)):
+            guard.raise_error(n_members, what="stacker params")
+
 
 class StackingRegressor(_StackingParams):
     is_classifier = False
@@ -168,17 +238,34 @@ class StackingRegressor(_StackingParams):
         """Fit; with ``mesh`` heterogeneous member fits are placed
         round-robin on the mesh's devices (see ``_fit_bases``)."""
         X, y = as_f32(X), as_f32(y)
+        self._validate_fit_inputs(X, y)
         w = resolve_weights(y, sample_weight)
         telem = FitTelemetry.start(self, n=X.shape[0], d=X.shape[1])
         telem.phase_mark("setup")
+        guard = self._numeric_guard(telem)
         models = self._fit_bases(
             self._bases(), X, y, w, sample_weight, mesh=mesh, telem=telem
         )
+        models = self._drop_bad_base_models(models, guard)
         meta = jnp.stack([m.predict(X) for m in models], axis=1)  # [n, num_bases]
         stacker = self._stacker()
-        stack_model = stacker.fit(
-            meta, y, sample_weight=w, **mesh_fit_kwargs(stacker, mesh)
+        from spark_ensemble_tpu.robustness.chaos import controller
+        from spark_ensemble_tpu.robustness.retry import retry_call
+
+        ctl = controller()
+        site = f"{type(self).__name__}:stacker"
+
+        def fit_stacker():
+            ctl.transient(site)
+            return stacker.fit(
+                meta, y, sample_weight=w, **mesh_fit_kwargs(stacker, mesh)
+            )
+
+        stack_model = retry_call(
+            fit_stacker, policy=self._retry_policy(),
+            op=f"{type(self).__name__}.stacker_fit", telem=telem,
         )
+        self._check_stacker(stack_model, len(models), guard)
         if telem.enabled:
             block_on_arrays(stack_model)
             telem.phase_mark("stacker")
@@ -240,24 +327,41 @@ class StackingClassifier(_StackingParams):
         """Fit; with ``mesh`` heterogeneous member fits are placed
         round-robin on the mesh's devices (see ``_fit_bases``)."""
         X, y = as_f32(X), as_f32(y)
+        self._validate_fit_inputs(X, y)
         w = resolve_weights(y, sample_weight)
         num_classes = infer_num_classes(y, num_classes)
         telem = FitTelemetry.start(
             self, n=X.shape[0], d=X.shape[1], num_classes=int(num_classes)
         )
         telem.phase_mark("setup")
+        guard = self._numeric_guard(telem)
         models = self._fit_bases(
             self._bases(), X, y, w, sample_weight, num_classes=num_classes,
             mesh=mesh, telem=telem,
         )
+        models = self._drop_bad_base_models(models, guard)
         meta = self._meta_features(models, X)
         stacker = self._stacker()
         kw = mesh_fit_kwargs(stacker, mesh)
-        stack_model = (
-            stacker.fit(meta, y, sample_weight=w, num_classes=num_classes, **kw)
-            if stacker.is_classifier
-            else stacker.fit(meta, y, sample_weight=w, **kw)
+        from spark_ensemble_tpu.robustness.chaos import controller
+        from spark_ensemble_tpu.robustness.retry import retry_call
+
+        ctl = controller()
+        site = f"{type(self).__name__}:stacker"
+
+        def fit_stacker():
+            ctl.transient(site)
+            if stacker.is_classifier:
+                return stacker.fit(
+                    meta, y, sample_weight=w, num_classes=num_classes, **kw
+                )
+            return stacker.fit(meta, y, sample_weight=w, **kw)
+
+        stack_model = retry_call(
+            fit_stacker, policy=self._retry_policy(),
+            op=f"{type(self).__name__}.stacker_fit", telem=telem,
         )
+        self._check_stacker(stack_model, len(models), guard)
         if telem.enabled:
             block_on_arrays(stack_model)
             telem.phase_mark("stacker")

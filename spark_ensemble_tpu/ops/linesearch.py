@@ -40,11 +40,25 @@ def brent_minimize(
     Matches commons-math ``BrentOptimizer(rel=tol, abs=tol)`` stopping
     semantics closely enough for GBM step sizes; ``f`` is traced, so each
     iteration is one fused objective evaluation.
+
+    NaN objective values are treated as +inf: Brent's bracketing updates
+    are pure comparisons, and a NaN ``f(u)`` (an overflowing loss at an
+    aggressive trial point) fails BOTH ``fu <= fx`` and its negation's
+    bookkeeping, silently corrupting the bracket.  Mapping NaN to +inf
+    makes such points ordinary rejections, so the returned step size stays
+    finite whenever any bracketed point evaluates finite — the step-size
+    half of the training-runtime numeric guards (docs/robustness.md); the
+    per-round weight check in the GBM driver is the other half.
     """
+
+    def f_safe(x):
+        fx = f(x)
+        return jnp.where(jnp.isnan(fx), jnp.inf, fx)
+
     lo = jnp.asarray(lo, jnp.float32)
     hi = jnp.asarray(hi, jnp.float32)
     x0 = lo + _CGOLD * (hi - lo)
-    f0 = f(x0)
+    f0 = f_safe(x0)
 
     # state: (a, b, x, w, v, fx, fw, fv, d, e, it, done)
     init = (lo, hi, x0, x0, x0, f0, f0, f0, 0.0, 0.0, 0, False)
@@ -90,7 +104,7 @@ def brent_minimize(
         u = jnp.where(
             jnp.abs(d_new) >= tol1, x + d_new, x + jnp.sign(d_new) * tol1
         )
-        fu = f(u)
+        fu = f_safe(u)
 
         better = fu <= fx
         a_n = jnp.where(better, jnp.where(u >= x, x, a), jnp.where(u < x, u, a))

@@ -1,0 +1,47 @@
+"""Fault-tolerant training runtime: numeric guards, retry/backoff,
+crash-consistent resume, and a deterministic chaos-injection harness.
+
+At production scale fits die mid-round — preemptions, transient device
+errors, NaN gradients from a bad step size.  The reference has no story for
+any of these (training is not even resumable there, SURVEY.md §5); XGBoost-
+class systems treat recoverability as a first-class feature (arXiv
+1806.11248).  This package is that feature for the four ensemble families:
+
+- ``guards``: a fused non-finite check over each round chunk's outputs
+  (member params, step sizes, losses) with a configurable ``on_nonfinite``
+  policy — ``raise`` | ``skip_round`` | ``halve_step`` | ``stop_early``
+  (``off`` disables the check entirely);
+- ``retry``: exponential backoff + deterministic jitter around round
+  dispatch and checkpoint I/O for transient ``RuntimeError``/XLA device
+  errors, with ``retry`` events on the telemetry stream;
+- ``validate``: fail-fast NaN/Inf input validation at ``fit()`` entry
+  (``allow_nan=True`` is the escape hatch);
+- ``chaos``: a deterministic fault injector (``SE_TPU_CHAOS``) for NaN
+  gradients, mid-round preemption, transient errors, and checkpoint
+  corruption — how all of the above is exercised in CI (docs/robustness.md).
+"""
+
+from spark_ensemble_tpu.robustness.chaos import (
+    ChaosController,
+    ChaosPreemption,
+    ChaosTransientError,
+)
+from spark_ensemble_tpu.robustness.guards import (
+    NONFINITE_POLICIES,
+    NonFiniteError,
+    NumericGuard,
+)
+from spark_ensemble_tpu.robustness.retry import RetryPolicy, retry_call
+from spark_ensemble_tpu.robustness.validate import validate_fit_inputs
+
+__all__ = [
+    "ChaosController",
+    "ChaosPreemption",
+    "ChaosTransientError",
+    "NONFINITE_POLICIES",
+    "NonFiniteError",
+    "NumericGuard",
+    "RetryPolicy",
+    "retry_call",
+    "validate_fit_inputs",
+]

@@ -1,0 +1,275 @@
+"""Deterministic chaos-injection harness for the training runtime.
+
+Fault tolerance that is never exercised is fault tolerance that does not
+work.  This module injects the four failure modes the runtime defends
+against — NaN gradients, mid-round preemption, transient device errors,
+checkpoint corruption — at well-defined *sites* inside the fit loops, with
+fully deterministic draws so a failing CI run reproduces locally from the
+seed alone.
+
+Environment contract (read once, cached):
+
+- ``SE_TPU_CHAOS``: enables injection; an integer seed (non-numeric values
+  are hashed to one).  Unset/empty → no-op controller.
+- ``SE_TPU_CHAOS_FAULTS``: comma list restricting the active fault kinds
+  (subset of ``nan_grad,preempt,transient,ckpt_corrupt``; default all).
+- ``SE_TPU_CHAOS_RATE``: per-site firing probability (default 0.05).
+- ``SE_TPU_CHAOS_LOG``: JSONL path appending one record per injected fault
+  (uploaded as a CI artifact next to the telemetry stream).
+
+Every fault fires **at most once per site** so retried/replayed work
+succeeds deterministically on the second attempt, and ``preempt`` carries a
+global budget (default 1) so a high rate kills a fit once, not forever.
+Tests bypass the environment entirely via :func:`install`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import zlib
+from typing import Dict, Iterable, Optional, Set, Tuple
+
+logger = logging.getLogger("spark_ensemble_tpu")
+
+FAULT_KINDS = ("nan_grad", "preempt", "transient", "ckpt_corrupt")
+
+
+class ChaosPreemption(Exception):
+    """Injected mid-round kill.  Deliberately **not** a ``RuntimeError`` so
+    the retry layer never swallows it — a preemption must propagate and be
+    recovered via checkpoint resume, exactly like a real SIGTERM."""
+
+
+class ChaosTransientError(RuntimeError):
+    """Injected transient device error; a ``RuntimeError`` on purpose so
+    the retry/backoff layer treats it like a real XLA hiccup."""
+
+
+class ChaosController:
+    """Deterministic per-site fault injector.
+
+    ``seed`` fixes every draw; ``rate`` is the per-site firing probability;
+    ``faults`` restricts the active kinds; ``budgets`` optionally caps the
+    total firings per kind (``preempt`` defaults to 1).  A draw for a given
+    ``(fault, site)`` pair is a pure function of the seed, so two runs that
+    visit the same sites inject the same faults.
+    """
+
+    enabled = True
+
+    def __init__(
+        self,
+        seed: int = 0,
+        rate: float = 1.0,
+        faults: Optional[Iterable[str]] = None,
+        budgets: Optional[Dict[str, Optional[int]]] = None,
+        log_path: Optional[str] = None,
+    ):
+        kinds = tuple(faults) if faults is not None else FAULT_KINDS
+        unknown = set(kinds) - set(FAULT_KINDS)
+        if unknown:
+            raise ValueError(
+                f"unknown chaos fault kinds {sorted(unknown)}; "
+                f"expected a subset of {FAULT_KINDS}"
+            )
+        self.seed = int(seed)
+        self.rate = float(rate)
+        self.faults: Set[str] = set(kinds)
+        self.budgets: Dict[str, Optional[int]] = {"preempt": 1}
+        if budgets:
+            self.budgets.update(budgets)
+        self.log_path = log_path
+        self.fired: list = []  # (fault, site) in firing order
+        self._counts: Dict[str, int] = {}
+        self._seen: Set[Tuple[str, str]] = set()
+        self._lock = threading.Lock()
+
+    # -- draw machinery ----------------------------------------------------
+
+    def _draw(self, fault: str, site: str) -> float:
+        """Uniform [0,1) draw, a pure function of (seed, fault, site)."""
+        h = zlib.crc32(f"{self.seed}:{fault}:{site}".encode())
+        return (h & 0xFFFFFFFF) / 2**32
+
+    def _fire(self, fault: str, site: str) -> bool:
+        if fault not in self.faults:
+            return False
+        with self._lock:
+            key = (fault, site)
+            if key in self._seen:
+                return False  # at-most-once per site: retries succeed
+            budget = self.budgets.get(fault)
+            if budget is not None and self._counts.get(fault, 0) >= budget:
+                return False
+            if self._draw(fault, site) >= self.rate:
+                return False
+            self._seen.add(key)
+            self._counts[fault] = self._counts.get(fault, 0) + 1
+            self.fired.append(key)
+        self._log(fault, site)
+        return True
+
+    def _log(self, fault: str, site: str) -> None:
+        logger.warning("chaos: injecting %s at %s", fault, site)
+        if not self.log_path:
+            return
+        rec = {"ts": time.time(), "fault": fault, "site": site,
+               "seed": self.seed}
+        try:
+            with open(self.log_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:
+            logger.exception("chaos: could not append to %s", self.log_path)
+
+    def pick(self, fault: str, site: str, n: int) -> int:
+        """Deterministic index in [0, n) — which round/member to poison."""
+        h = zlib.crc32(f"{self.seed}:{fault}:{site}:pick".encode())
+        return int(h % max(n, 1))
+
+    # -- injection hooks (called from the runtime) -------------------------
+
+    def transient(self, site: str) -> None:
+        """Raise a retryable :class:`ChaosTransientError` (at most once per
+        site, so the retry layer's second attempt succeeds)."""
+        if self._fire("transient", site):
+            raise ChaosTransientError(f"chaos: transient fault at {site}")
+
+    def preempt(self, site: str) -> None:
+        """Raise a :class:`ChaosPreemption` (globally budgeted; default 1)."""
+        if self._fire("preempt", site):
+            raise ChaosPreemption(f"chaos: preempted at {site}")
+
+    def poison_array(self, site: str, arr):
+        """Return ``arr`` with one leading-axis slice set to NaN (or ``arr``
+        unchanged when the site does not fire)."""
+        if arr is None or not self._fire("nan_grad", site):
+            return arr
+        import jax.numpy as jnp
+
+        j = self.pick("nan_grad", site, arr.shape[0])
+        return arr.at[j].set(jnp.nan)
+
+    def poison_member_stack(self, site: str, tree):
+        """Poison one stacked member: NaN the picked leading-axis index of
+        the first floating leaf in ``tree``."""
+        if not self._fire("nan_grad", site):
+            return tree
+        import jax
+        import jax.numpy as jnp
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        for i, leaf in enumerate(leaves):
+            if hasattr(leaf, "dtype") and jnp.issubdtype(
+                leaf.dtype, jnp.inexact
+            ):
+                j = self.pick("nan_grad", site, leaf.shape[0])
+                leaves[i] = leaf.at[j].set(jnp.nan)
+                break
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def poison_tree(self, site: str, tree):
+        """NaN a single element of the first floating leaf of ``tree``
+        (used for unstacked per-member models, e.g. stacking bases)."""
+        if not self._fire("nan_grad", site):
+            return tree
+        import jax
+        import jax.numpy as jnp
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        for i, leaf in enumerate(leaves):
+            if hasattr(leaf, "dtype") and jnp.issubdtype(
+                leaf.dtype, jnp.inexact
+            ):
+                flat = jnp.ravel(leaf).at[0].set(jnp.nan)
+                leaves[i] = flat.reshape(leaf.shape)
+                break
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def corrupt_checkpoint(self, site: str, state_path: str) -> None:
+        """Truncate a just-written ``state.json`` mid-byte, simulating a
+        crash during the (non-atomic on some filesystems) write."""
+        if not self._fire("ckpt_corrupt", site):
+            return
+        try:
+            with open(state_path, "r+b") as f:
+                f.truncate(max(f.seek(0, 2) // 2, 1))
+        except OSError:
+            logger.exception("chaos: could not corrupt %s", state_path)
+
+
+class _NoopController:
+    """Injection disabled: every hook is a cheap no-op/identity."""
+
+    enabled = False
+    fired: tuple = ()
+
+    def transient(self, site: str) -> None:
+        pass
+
+    def preempt(self, site: str) -> None:
+        pass
+
+    def poison_array(self, site: str, arr):
+        return arr
+
+    def poison_member_stack(self, site: str, tree):
+        return tree
+
+    def poison_tree(self, site: str, tree):
+        return tree
+
+    def corrupt_checkpoint(self, site: str, state_path: str) -> None:
+        pass
+
+
+_NOOP = _NoopController()
+_installed: Optional[object] = None
+_env_cache: Optional[Tuple[tuple, object]] = None
+_cache_lock = threading.Lock()
+
+
+def install(ctrl) -> None:
+    """Override the process controller (tests); ``install(None)`` reverts
+    to the environment-configured one."""
+    global _installed
+    _installed = ctrl
+
+
+def _from_env():
+    raw = os.environ.get("SE_TPU_CHAOS", "").strip()
+    if not raw:
+        return None
+    seed = int(raw) if raw.lstrip("+-").isdigit() else zlib.crc32(raw.encode())
+    faults_raw = os.environ.get("SE_TPU_CHAOS_FAULTS", "").strip()
+    faults = (
+        tuple(p.strip() for p in faults_raw.split(",") if p.strip())
+        if faults_raw
+        else None
+    )
+    rate = float(os.environ.get("SE_TPU_CHAOS_RATE", "0.05"))
+    log_path = os.environ.get("SE_TPU_CHAOS_LOG") or None
+    return seed, faults, rate, log_path
+
+
+def controller():
+    """The active controller: an installed one, else env-configured
+    (cached until the relevant env vars change), else a no-op."""
+    global _env_cache
+    if _installed is not None:
+        return _installed
+    cfg = _from_env()
+    if cfg is None:
+        return _NOOP
+    with _cache_lock:
+        if _env_cache is not None and _env_cache[0] == cfg:
+            return _env_cache[1]
+        seed, faults, rate, log_path = cfg
+        ctrl = ChaosController(
+            seed=seed, rate=rate, faults=faults, log_path=log_path
+        )
+        _env_cache = (cfg, ctrl)
+        return ctrl

@@ -1,0 +1,186 @@
+"""Numeric guards: fused non-finite detection over per-round outputs.
+
+A single non-finite gradient (from an overflowing line-search step, a bad
+learning rate, or an injected chaos fault) silently poisons every later
+round — boosting's residuals, GBM's running prediction, bagging's stacked
+members.  The guard catches it the round it happens: one jitted reduction
+over the chunk's outputs (member params, step sizes, losses — all carrying
+a leading round axis) produces a per-round ``bool`` vector, and only that
+tiny vector crosses to the host.  Cost is O(bytes already produced) fused
+elementwise work per chunk — measured as ``robustness_overhead_pct`` in
+bench.py and budgeted < 2%.
+
+Recovery is policy-driven (``on_nonfinite`` estimator param):
+
+- ``raise``    — fail fast with :class:`NonFiniteError` (default);
+- ``skip_round``  — drop the poisoned round's contribution, keep going;
+- ``halve_step``  — re-run the round with a halved line-search step until
+  finite (GBM; families without a scalable step degrade to skip);
+- ``stop_early``  — truncate the ensemble to the last good round;
+- ``off``      — no check at all (opt out of the guard's cost).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("spark_ensemble_tpu")
+
+NONFINITE_POLICIES = ("off", "raise", "skip_round", "halve_step",
+                      "stop_early")
+
+
+class NonFiniteError(FloatingPointError):
+    """A non-finite value surfaced in a round's outputs under the
+    ``on_nonfinite="raise"`` policy.  Carries ``family`` and ``round_index``
+    so the failure is attributable without re-running."""
+
+    def __init__(self, message: str, family: str = "",
+                 round_index: Optional[int] = None):
+        super().__init__(message)
+        self.family = family
+        self.round_index = round_index
+
+
+def _inexact_leaves(trees):
+    import jax
+    import jax.numpy as jnp
+
+    leaves = []
+    for tree in trees:
+        if tree is None:
+            continue
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if hasattr(leaf, "dtype") and jnp.issubdtype(
+                jnp.asarray(leaf).dtype, jnp.inexact
+            ):
+                leaves.append(jnp.asarray(leaf))
+    return leaves
+
+
+_flags_fn = None
+
+
+def round_nonfinite_flags(nan_leaves, strict_leaves):
+    """``bool[c]`` — per-round badness over leaves that all share leading
+    round axis ``c``.  ``nan_leaves`` are checked for NaN only (member
+    params legitimately carry ±Inf — tree split thresholds use Inf
+    sentinels for leaves/unused levels); ``strict_leaves`` (step sizes,
+    losses) must be fully finite.  One fused jitted reduction; retraces
+    only per distinct (length, shape) combination, which the chunk-program
+    cache already bounds."""
+    global _flags_fn
+    if _flags_fn is None:
+        import jax
+        import jax.numpy as jnp
+
+        def _flags(nan_ls, strict_ls):
+            out = None
+            for x in nan_ls:
+                bad = jnp.any(jnp.isnan(x.reshape(x.shape[0], -1)), axis=1)
+                out = bad if out is None else out | bad
+            for x in strict_ls:
+                bad = jnp.any(
+                    ~jnp.isfinite(x.reshape(x.shape[0], -1)), axis=1
+                )
+                out = bad if out is None else out | bad
+            return out
+
+        _flags_fn = jax.jit(_flags)
+    return _flags_fn(nan_leaves, strict_leaves)
+
+
+def tree_any_nan(*trees) -> bool:
+    """Host bool: any NaN anywhere in the given pytrees (whole-model check
+    for families without a round axis; NaN-only for the same Inf-sentinel
+    reason as :func:`round_nonfinite_flags`)."""
+    leaves = _inexact_leaves(trees)
+    if not leaves:
+        return False
+    import jax
+    import jax.numpy as jnp
+
+    bad = jax.jit(
+        lambda ls: jnp.any(jnp.stack([jnp.any(jnp.isnan(x)) for x in ls]))
+    )(leaves)
+    return bool(bad)
+
+
+class NumericGuard:
+    """Per-fit guard instance: detection + policy + telemetry.
+
+    The drivers own *recovery* (they hold the carried state to snapshot and
+    replay); the guard owns detection (:meth:`first_nonfinite`,
+    :meth:`member_flags`), policy validation, and the ``guard_nonfinite``
+    event record.
+    """
+
+    def __init__(self, policy: str, family: str = "", telem=None,
+                 max_halvings: int = 4):
+        if policy not in NONFINITE_POLICIES:
+            raise ValueError(
+                f"on_nonfinite must be one of {NONFINITE_POLICIES}, "
+                f"got {policy!r}"
+            )
+        self.policy = policy
+        self.family = family
+        self.telem = telem
+        self.max_halvings = max_halvings
+
+    @property
+    def active(self) -> bool:
+        return self.policy != "off"
+
+    def first_nonfinite(self, params, *arrays) -> Optional[int]:
+        """Index of the first bad round in a chunk whose trees all carry a
+        leading round axis, or ``None`` when the chunk is clean.
+
+        ``params`` (the member-params pytree) is checked for NaN only —
+        tree encodings legitimately carry ±Inf split-threshold sentinels;
+        ``arrays`` (step sizes, losses) must be fully finite."""
+        nan_leaves = _inexact_leaves((params,))
+        strict_leaves = _inexact_leaves(arrays)
+        if not nan_leaves and not strict_leaves:
+            return None
+        flags = np.asarray(round_nonfinite_flags(nan_leaves, strict_leaves))
+        idx = np.flatnonzero(flags)
+        return int(idx[0]) if idx.size else None
+
+    def member_flags(self, params, *arrays) -> Optional[np.ndarray]:
+        """``bool[m]`` per-member badness flags for stacked members
+        (bagging), or ``None`` when nothing to check.  Same NaN-only
+        semantics for ``params`` as :meth:`first_nonfinite`."""
+        nan_leaves = _inexact_leaves((params,))
+        strict_leaves = _inexact_leaves(arrays)
+        if not nan_leaves and not strict_leaves:
+            return None
+        return np.asarray(round_nonfinite_flags(nan_leaves, strict_leaves))
+
+    def record(self, round_index: int, action: str, **extra) -> None:
+        """Log + emit a ``guard_nonfinite`` telemetry event describing what
+        the policy did about a detection."""
+        logger.warning(
+            "[%s] non-finite round output at round %d -> %s",
+            self.family, round_index, action,
+        )
+        if self.telem is not None:
+            self.telem.emit(
+                "guard_nonfinite",
+                round=round_index,
+                policy=self.policy,
+                action=action,
+                **extra,
+            )
+
+    def raise_error(self, round_index: int, what: str = "round outputs"):
+        self.record(round_index, "raise")
+        raise NonFiniteError(
+            f"non-finite {what} at round {round_index} in "
+            f"{self.family or 'fit'} (on_nonfinite='raise'; see "
+            "docs/robustness.md for recovery policies)",
+            family=self.family,
+            round_index=round_index,
+        )

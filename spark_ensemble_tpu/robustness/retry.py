@@ -1,0 +1,91 @@
+"""Retry with exponential backoff + deterministic jitter.
+
+Transient ``RuntimeError``/XLA device errors (a flaky DMA, a preempted
+all-reduce, an interconnect blip) are recoverable by simply re-running the
+dispatched round program — jax dispatch is functional, so a retried chunk
+recomputes from the same carried state.  This wraps round execution and
+checkpoint I/O in a bounded retry loop; each retry emits a ``retry`` event
+on the telemetry stream (docs/telemetry.md) so recovery is observable, not
+silent.
+
+Jitter is derived deterministically from the operation name + attempt
+number (not ``random.random()``): backoff schedules reproduce exactly under
+the chaos harness, and concurrent member fits (stacking's threaded pool)
+still decorrelate because their op names differ.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+import zlib
+from typing import Callable, Optional, Tuple, Type
+
+logger = logging.getLogger("spark_ensemble_tpu")
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff: delay ``base_delay * 2**(attempt-1)``
+    capped at ``max_delay``, plus up to ``jitter`` fraction of itself.
+
+    ``max_retries`` counts *re*-attempts: 2 means up to 3 calls total; 0
+    disables retry entirely.  Only ``retry_on`` exception types are retried
+    — anything else (including :class:`ChaosPreemption`, ``KeyboardInterrupt``)
+    propagates immediately.
+    """
+
+    max_retries: int = 2
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    jitter: float = 0.25
+    retry_on: Tuple[Type[BaseException], ...] = (RuntimeError, OSError)
+
+    def delay(self, op: str, attempt: int) -> float:
+        """Backoff before re-attempt ``attempt`` (1-based), deterministic
+        in ``(op, attempt)``."""
+        raw = min(self.base_delay * (2.0 ** (attempt - 1)), self.max_delay)
+        h = zlib.crc32(f"{op}:{attempt}".encode()) & 0xFFFFFFFF
+        return raw * (1.0 + self.jitter * (h / 2**32))
+
+
+def retry_call(
+    fn: Callable,
+    policy: Optional[RetryPolicy] = None,
+    op: str = "",
+    telem=None,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Call ``fn()`` under ``policy``; returns its result.
+
+    On a retryable failure, emits a ``retry`` telemetry event (operation,
+    attempt, backoff delay, error type) and re-raises once ``max_retries``
+    is exhausted.  ``telem=None`` (or a disabled telemetry) just logs.
+    """
+    policy = policy or RetryPolicy()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except policy.retry_on as e:
+            attempt += 1
+            if attempt > policy.max_retries:
+                raise
+            delay = policy.delay(op, attempt)
+            logger.warning(
+                "retrying %s after %s (attempt %d/%d, backoff %.3fs): %s",
+                op or "operation", type(e).__name__, attempt,
+                policy.max_retries, delay, e,
+            )
+            if telem is not None:
+                telem.emit(
+                    "retry",
+                    op=op,
+                    attempt=attempt,
+                    max_retries=policy.max_retries,
+                    delay_s=round(delay, 6),
+                    error_type=type(e).__name__,
+                    error=str(e)[:500],
+                )
+            sleep(delay)

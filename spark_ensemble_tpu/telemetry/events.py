@@ -35,6 +35,7 @@ from __future__ import annotations
 import contextlib
 import itertools
 import json
+import logging
 import os
 import threading
 import time
@@ -44,6 +45,8 @@ import numpy as np
 
 from spark_ensemble_tpu.telemetry.registry import MetricsRegistry
 from spark_ensemble_tpu.utils.instrumentation import block_on_arrays
+
+logger = logging.getLogger("spark_ensemble_tpu")
 
 __all__ = [
     "FitTelemetry",
@@ -261,9 +264,18 @@ class FitTelemetry:
             start_ev["d"] = int(d)
         start_ev.update(meta)
         telem._emit(start_ev)
+        _stack().append(telem)
         return telem
 
     # -- emission ---------------------------------------------------------
+
+    def emit(self, event: str, **fields) -> None:
+        """Append an ad-hoc structured event (``retry``, ``guard_nonfinite``,
+        ``resume_from_checkpoint``, ...) to the stream — the hook the
+        robustness runtime reports through (docs/robustness.md)."""
+        ev: Dict[str, Any] = {"event": event}
+        ev.update(fields)
+        self._emit(ev)
 
     def _emit(self, event: Dict[str, Any]) -> None:
         event = dict(event)
@@ -394,6 +406,7 @@ class FitTelemetry:
         if self._finished:
             return
         self._finished = True
+        self._unregister()
         self.phase_mark("finalize")
         wall = time.perf_counter() - self._t0
         with self._lock:
@@ -422,6 +435,40 @@ class FitTelemetry:
             _append_jsonl(self._path, events)
         if model is not None:
             model.fit_history_ = self.history()
+
+    def abort(self, error: BaseException, **outcome) -> None:
+        """Terminal record for a fit that raised mid-round: emit
+        ``fit_aborted`` (exception type + message, last completed round,
+        phase breakdown) and flush the JSONL sink, so every stream ends
+        with a terminal record even when ``fit()`` never returns."""
+        if self._finished:
+            return
+        self._finished = True
+        self._unregister()
+        self.phase_mark("aborted")
+        wall = time.perf_counter() - self._t0
+        with self._lock:
+            phases = dict(self._phases)
+        ev: Dict[str, Any] = {
+            "event": "fit_aborted",
+            "family": self.family,
+            "wall_s": wall,
+            "rounds": self._rounds,
+            "error_type": type(error).__name__,
+            "error": str(error)[:500],
+            "phases": phases,
+        }
+        ev.update(outcome)
+        self._emit(ev)
+        if self._path:
+            with self._lock:
+                events = list(self._events)
+            _append_jsonl(self._path, events)
+
+    def _unregister(self) -> None:
+        st = _stack()
+        if self in st:
+            st.remove(self)
 
     # -- consumption ------------------------------------------------------
 
@@ -499,6 +546,9 @@ class _DisabledFitTelemetry(FitTelemetry):
             # not telemetry ran; empty arrays keep downstream code uniform
             model.fit_history_ = self.history()
 
+    def abort(self, error, **outcome):
+        pass
+
     def events(self):
         return []
 
@@ -513,3 +563,39 @@ class _DisabledFitTelemetry(FitTelemetry):
 
 
 _DISABLED = _DisabledFitTelemetry()
+
+
+# -- active-fit stack (terminal fit_aborted records) -----------------------
+#
+# Each live FitTelemetry registers on a thread-local stack at start() and
+# unregisters at finish()/abort().  The instrumented_fit wrapper snapshots
+# the depth before running a fit body and, when the body raises, aborts
+# everything pushed above that snapshot — so nested fits (GBM's init model,
+# stacking's threaded members) each get their own terminal record without
+# the families having to thread try/except through every loop.
+
+_FIT_TLS = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_FIT_TLS, "items", None)
+    if st is None:
+        st = _FIT_TLS.items = []
+    return st
+
+
+def active_fit_depth() -> int:
+    """Depth of this thread's live-fit stack (see instrumented_fit)."""
+    return len(_stack())
+
+
+def abort_active_fits(depth: int, error: BaseException) -> None:
+    """Abort (emit ``fit_aborted`` + flush) every telemetry registered on
+    this thread above ``depth``, innermost first."""
+    st = _stack()
+    while len(st) > depth:
+        telem = st.pop()
+        try:
+            telem.abort(error)
+        except Exception:
+            logger.exception("failed to flush fit_aborted record")

@@ -8,17 +8,29 @@ training-state checkpoint — round index, member params so far, estimator
 weights, the prediction/boosting-weight arrays, patience counters — written
 atomically every N rounds, from which ``fit`` resumes mid-run after
 preemption.
+
+Crash consistency: every save writes a ``manifest.json`` (sha256 + byte
+size per file) inside the checkpoint directory before the atomic swap, and
+the previous good checkpoint is **retained** as ``.ckpt-old`` (one extra
+checkpoint of disk, reclaimed by ``delete()`` at fit end).  ``load_latest``
+verifies the manifest and falls back ``latest`` → ``.ckpt-old`` → fresh
+start instead of crashing on a truncated/corrupt ``state.json``; writes go
+through the retry/backoff layer for transient filesystem errors.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import os
 import shutil
 import tempfile
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
+
+logger = logging.getLogger("spark_ensemble_tpu")
 
 
 # Bumped whenever the persisted member-pytree schema changes in a way a
@@ -29,6 +41,14 @@ import numpy as np
 # load across versions via per-class _persist_defaults hooks; only
 # mid-training state is version-pinned.
 _CHECKPOINT_FORMAT = 3  # 3: GBM state carries val_hist (round-aligned)
+
+
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
 
 
 def run_fingerprint(*parts) -> str:
@@ -62,11 +82,18 @@ class TrainingCheckpointer:
         interval: int = 10,
         fingerprint: Optional[str] = None,
         async_save: bool = True,
+        retry_policy=None,
+        telem=None,
     ):
         self.directory = directory
         self.interval = max(int(interval), 1)
         self.fingerprint = fingerprint
         self.async_save = bool(async_save)
+        self.retry_policy = retry_policy
+        self.telem = telem
+        # set by load_latest: {"round", "source", "fallback"} describing
+        # which on-disk copy a resume actually came from
+        self.last_load_detail: Optional[Dict[str, Any]] = None
         self._executor = None
         self._pending = None
 
@@ -126,6 +153,23 @@ class TrainingCheckpointer:
         )
 
     def _save_sync(self, round_idx: int, state: Dict[str, Any]) -> None:
+        from spark_ensemble_tpu.robustness.chaos import controller
+        from spark_ensemble_tpu.robustness.retry import retry_call
+
+        retry_call(
+            lambda: self._write(round_idx, state),
+            policy=self.retry_policy,
+            op="checkpoint.save",
+            telem=self.telem,
+        )
+        # chaos hook: simulate a crash mid-write AFTER the swap — exactly
+        # the torn state load_latest's manifest check must recover from
+        controller().corrupt_checkpoint(
+            f"ckpt:{self.directory}:{round_idx}",
+            os.path.join(self.directory, "latest", "state.json"),
+        )
+
+    def _write(self, round_idx: int, state: Dict[str, Any]) -> None:
         from spark_ensemble_tpu.utils.persist import _encode
 
         os.makedirs(self.directory, exist_ok=True)
@@ -145,45 +189,104 @@ class TrainingCheckpointer:
                 )
             if arrays:
                 np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
+            manifest = {"round": round_idx, "files": {}}
+            for name in ("state.json", "arrays.npz"):
+                p = os.path.join(tmp, name)
+                if os.path.exists(p):
+                    manifest["files"][name] = {
+                        "sha256": _file_sha256(p),
+                        "bytes": os.path.getsize(p),
+                    }
+            with open(os.path.join(tmp, "manifest.json"), "w") as f:
+                json.dump(manifest, f)
             final = os.path.join(self.directory, "latest")
             stale = os.path.join(self.directory, ".ckpt-old")
             if os.path.exists(final):
+                # retain the displaced 'latest' as the crash-consistent
+                # fallback; only the older generation is reclaimed
+                if os.path.exists(stale):
+                    shutil.rmtree(stale)
                 os.rename(final, stale)
             os.rename(tmp, final)
-            if os.path.exists(stale):
-                shutil.rmtree(stale)
         except Exception:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
 
     def load_latest(self) -> Optional[Tuple[int, Dict[str, Any]]]:
+        """Newest loadable checkpoint, or ``None``: tries ``latest`` then
+        falls back to the retained ``.ckpt-old`` when 'latest' is
+        truncated/corrupt (manifest checksum mismatch, undecodable
+        state.json) — a crash between the two rename()s of a save, or a
+        torn write on a non-atomic filesystem, must cost one checkpoint
+        interval, not the whole run."""
         if not self.enabled:
             return None
         self.wait()
-        final = os.path.join(self.directory, "latest")
-        if not os.path.exists(os.path.join(final, "state.json")):
+        self.last_load_detail = None
+        for source in ("latest", ".ckpt-old"):
+            loaded = self._load_dir(os.path.join(self.directory, source))
+            if loaded is None:
+                continue
+            fallback = source != "latest"
+            if fallback:
+                logger.warning(
+                    "checkpoint 'latest' in %s is unusable; resuming from "
+                    "the retained .ckpt-old copy (round %d)",
+                    self.directory, loaded[0],
+                )
+            self.last_load_detail = {
+                "round": loaded[0], "source": source, "fallback": fallback,
+            }
+            return loaded
+        return None
+
+    def _load_dir(self, path: str) -> Optional[Tuple[int, Dict[str, Any]]]:
+        """Decode one checkpoint directory; ``None`` on any corruption
+        (logged) or fingerprint mismatch instead of raising."""
+        state_path = os.path.join(path, "state.json")
+        if not os.path.exists(state_path):
             return None
         from spark_ensemble_tpu.utils.persist import _class_registry, _decode
 
-        with open(os.path.join(final, "state.json")) as f:
-            meta = json.load(f)
-        if meta.get("fingerprint") != self.fingerprint:
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "checkpoint in %s was written by a different run/config "
-                "(fingerprint %s != %s); ignoring it",
-                self.directory,
-                meta.get("fingerprint"),
-                self.fingerprint,
+        try:
+            manifest_path = os.path.join(path, "manifest.json")
+            if os.path.exists(manifest_path):
+                with open(manifest_path) as f:
+                    manifest = json.load(f)
+                for name, meta in manifest.get("files", {}).items():
+                    p = os.path.join(path, name)
+                    if (
+                        not os.path.exists(p)
+                        or os.path.getsize(p) != meta["bytes"]
+                        or _file_sha256(p) != meta["sha256"]
+                    ):
+                        logger.warning(
+                            "checkpoint %s failed its manifest check "
+                            "(%s corrupt/truncated); ignoring it",
+                            path, name,
+                        )
+                        return None
+            with open(state_path) as f:
+                meta = json.load(f)
+            if meta.get("fingerprint") != self.fingerprint:
+                logger.warning(
+                    "checkpoint in %s was written by a different run/config "
+                    "(fingerprint %s != %s); ignoring it",
+                    path, meta.get("fingerprint"), self.fingerprint,
+                )
+                return None
+            arrays = {}
+            npz = os.path.join(path, "arrays.npz")
+            if os.path.exists(npz):
+                arrays = dict(np.load(npz))
+            state = _decode(meta["spec"], arrays, _class_registry())
+            return int(meta["round"]), state
+        except Exception:  # noqa: BLE001 - any corruption -> fall back
+            logger.warning(
+                "checkpoint in %s is corrupt/unreadable; ignoring it",
+                path, exc_info=True,
             )
             return None
-        arrays = {}
-        npz = os.path.join(final, "arrays.npz")
-        if os.path.exists(npz):
-            arrays = dict(np.load(npz))
-        state = _decode(meta["spec"], arrays, _class_registry())
-        return int(meta["round"]), state
 
     def delete(self) -> None:
         """Training finished: remove the checkpoint entries THIS class wrote

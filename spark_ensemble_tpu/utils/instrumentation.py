@@ -83,11 +83,22 @@ def instrumented_fit(fit):
 
     @functools.wraps(fit)
     def wrapper(self, *args, **kwargs):
+        # lazy import: events imports block_on_arrays from this module
+        from spark_ensemble_tpu.telemetry import events as _events
+
         profile_dir = getattr(self, "profile_dir", None)
+        depth0 = _events.active_fit_depth()
         with instrumented(f"{type(self).__name__}.fit"), profile_trace(
             profile_dir
         ):
-            result = fit(self, *args, **kwargs)
+            try:
+                result = fit(self, *args, **kwargs)
+            except BaseException as e:
+                # terminal fit_aborted record for every telemetry this fit
+                # (and any nested fit on this thread) opened but never
+                # closed — JSONL streams always end with a terminal event
+                _events.abort_active_fits(depth0, e)
+                raise
             if profile_dir:
                 # jax dispatch is async: without blocking here the trace
                 # would stop at dispatch time and capture none of the

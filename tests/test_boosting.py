@@ -160,7 +160,8 @@ class _SpyBoostingClassifier(se.BoostingClassifier):
     """Records the chunk sizes the round driver dispatches."""
 
     def _drive_boosting_rounds(self, ckpt, bw, root, mc, wc, run_chunk,
-                               replay, start_i, ramp=False, telem=None):
+                               replay, start_i, ramp=False, telem=None,
+                               guard=None):
         self.dispatched = []
 
         def spy(keys, bw):
@@ -169,7 +170,7 @@ class _SpyBoostingClassifier(se.BoostingClassifier):
 
         return super()._drive_boosting_rounds(
             ckpt, bw, root, mc, wc, spy, replay, start_i, ramp=ramp,
-            telem=telem,
+            telem=telem, guard=guard,
         )
 
 

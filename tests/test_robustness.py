@@ -1,0 +1,479 @@
+"""Fault-tolerant training runtime tests (docs/robustness.md): input
+validation, numeric-guard policies on all four families, retry/backoff,
+crash-consistent checkpoint fallback, kill-and-resume equivalence, the
+chaos harness's determinism, and the ``fit_aborted`` terminal event."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import spark_ensemble_tpu as se
+from spark_ensemble_tpu.robustness import chaos
+from spark_ensemble_tpu.robustness.chaos import (
+    ChaosController,
+    ChaosPreemption,
+    ChaosTransientError,
+)
+from spark_ensemble_tpu.robustness.guards import (
+    NONFINITE_POLICIES,
+    NonFiniteError,
+    NumericGuard,
+)
+from spark_ensemble_tpu.robustness.retry import RetryPolicy, retry_call
+from spark_ensemble_tpu.robustness.validate import validate_fit_inputs
+from spark_ensemble_tpu.telemetry import record_fits
+from spark_ensemble_tpu.utils.checkpoint import TrainingCheckpointer
+
+
+def _data(n=120, d=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X @ rng.randn(d) + 0.1 * rng.randn(n)).astype(np.float32)
+    return X, y
+
+
+def _cls_data(n=120, d=5, seed=0):
+    X, y = _data(n, d, seed)
+    return X, (y > np.median(y)).astype(np.float32)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_chaos():
+    """Every test leaves the process chaos-free (the env path is also
+    bypassed: install(None) only clears an explicit controller, so tests
+    never see a stray SE_TPU_CHAOS from the invoking shell unless they are
+    the chaos CI job's tier-1 run — where the harness is the point)."""
+    yield
+    chaos.install(None)
+
+
+def _chaos(**kw):
+    kw.setdefault("rate", 1.0)
+    ctl = ChaosController(seed=kw.pop("seed", 11), **kw)
+    chaos.install(ctl)
+    return ctl
+
+
+# ---------------------------------------------------------------------------
+# input validation
+# ---------------------------------------------------------------------------
+
+
+def test_validate_raises_on_nan_features():
+    X, y = _data()
+    X[3, 1] = np.nan
+    with pytest.raises(ValueError, match="X contains NaN or Inf"):
+        se.GBMRegressor(num_base_learners=2).fit(X, y)
+
+
+def test_validate_raises_on_inf_labels():
+    X, y = _data()
+    y[7] = np.inf
+    with pytest.raises(ValueError, match="y contains NaN or Inf"):
+        se.BaggingRegressor(num_base_learners=2).fit(X, y)
+
+
+def test_validate_allow_nan_escape_hatch():
+    X, y = _data()
+    validate_fit_inputs(X, y)  # clean passes
+    X[0, 0] = np.nan
+    with pytest.raises(ValueError):
+        validate_fit_inputs(X, y)
+    validate_fit_inputs(X, y, allow_nan=True)  # no raise
+
+
+@pytest.mark.parametrize(
+    "est_cls",
+    [se.BoostingClassifier, se.BaggingClassifier, se.StackingClassifier],
+)
+def test_validate_wired_into_classifier_fits(est_cls):
+    X, y = _cls_data()
+    X[1, 1] = np.inf
+    with pytest.raises(ValueError, match="contains NaN or Inf"):
+        est_cls().fit(X, y)
+
+
+# ---------------------------------------------------------------------------
+# guard primitives
+# ---------------------------------------------------------------------------
+
+
+def test_guard_rejects_unknown_policy():
+    with pytest.raises(ValueError, match="on_nonfinite"):
+        NumericGuard("explode")
+    for p in NONFINITE_POLICIES:
+        NumericGuard(p)
+
+
+def test_guard_params_are_nan_only_arrays_are_strict():
+    import jax.numpy as jnp
+
+    g = NumericGuard("raise")
+    # tree params legitimately carry Inf split-threshold sentinels
+    params = {"thr": jnp.array([[jnp.inf, 1.0], [2.0, -jnp.inf]])}
+    weights = jnp.array([0.5, 0.25])
+    assert g.first_nonfinite(params, weights) is None
+    # NaN in params IS a detection
+    params_bad = {"thr": jnp.array([[1.0, 2.0], [jnp.nan, 3.0]])}
+    assert g.first_nonfinite(params_bad, weights) == 1
+    # Inf in the weight/step-size group IS a detection
+    assert g.first_nonfinite(params, jnp.array([0.5, jnp.inf])) == 1
+    assert g.first_nonfinite(params, jnp.array([jnp.nan, 1.0])) == 0
+
+
+def test_estimator_rejects_bad_policy_param():
+    with pytest.raises(ValueError):
+        se.GBMRegressor(on_nonfinite="explode")
+
+
+# ---------------------------------------------------------------------------
+# retry/backoff
+# ---------------------------------------------------------------------------
+
+
+def test_retry_transient_then_success_and_delays():
+    calls = []
+    slept = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    policy = RetryPolicy(max_retries=3, base_delay=0.05, jitter=0.0)
+    out = retry_call(flaky, policy=policy, op="t", sleep=slept.append)
+    assert out == "ok"
+    assert len(calls) == 3
+    # exponential backoff: base, 2*base
+    assert slept == pytest.approx([0.05, 0.10])
+
+
+def test_retry_exhaustion_reraises():
+    policy = RetryPolicy(max_retries=2, base_delay=0.0)
+
+    def always():
+        raise RuntimeError("down")
+
+    with pytest.raises(RuntimeError, match="down"):
+        retry_call(always, policy=policy, op="t", sleep=lambda s: None)
+
+
+def test_retry_zero_retries_and_non_retryable():
+    def boom():
+        raise RuntimeError("x")
+
+    with pytest.raises(RuntimeError):
+        retry_call(boom, policy=RetryPolicy(max_retries=0), op="t")
+
+    # ChaosPreemption deliberately does NOT derive from RuntimeError:
+    # a preemption must kill the fit, not be absorbed by the retry layer
+    def preempted():
+        raise ChaosPreemption("gone")
+
+    with pytest.raises(ChaosPreemption):
+        retry_call(
+            preempted, policy=RetryPolicy(max_retries=5),
+            op="t", sleep=lambda s: None,
+        )
+    assert not issubclass(ChaosPreemption, RuntimeError)
+    assert issubclass(ChaosTransientError, RuntimeError)
+
+
+def test_retry_emits_telemetry_event():
+    X, y = _data()
+    _chaos(seed=7, faults=("transient",))
+    with record_fits() as rec:
+        se.GBMRegressor(num_base_learners=2, scan_chunk=2).fit(X, y)
+    retries = [e for e in rec.events if e["event"] == "retry"]
+    assert retries, "transient chaos must surface a retry event"
+    ev = retries[0]
+    assert ev["error_type"] == "ChaosTransientError"
+    assert ev["attempt"] == 1
+    assert ev["delay_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# chaos harness
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_is_deterministic_and_at_most_once_per_site():
+    a = ChaosController(seed=5, rate=0.5, faults=("transient",))
+    b = ChaosController(seed=5, rate=0.5, faults=("transient",))
+    sites = [f"site:{i}" for i in range(40)]
+    for ctl in (a, b):
+        for s in sites:
+            try:
+                ctl.transient(s)
+            except ChaosTransientError:
+                pass
+            # second visit never fires (retries always succeed)
+            ctl.transient(s)
+    assert a.fired == b.fired
+    assert 0 < len(a.fired) < len(sites)
+
+
+def test_chaos_env_parsing(monkeypatch):
+    monkeypatch.setenv("SE_TPU_CHAOS", "42")
+    monkeypatch.setenv("SE_TPU_CHAOS_FAULTS", "transient,ckpt_corrupt")
+    monkeypatch.setenv("SE_TPU_CHAOS_RATE", "0.25")
+    chaos._env_cache = None  # drop the cached env controller
+    try:
+        ctl = chaos.controller()
+        assert ctl.enabled
+        assert ctl.seed == 42
+        assert ctl.rate == 0.25
+        assert ctl.faults == {"transient", "ckpt_corrupt"}
+    finally:
+        chaos._env_cache = None
+
+
+def test_chaos_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("SE_TPU_CHAOS", raising=False)
+    chaos._env_cache = None
+    assert not chaos.controller().enabled
+
+
+def test_chaos_log_jsonl(tmp_path):
+    log = tmp_path / "faults.jsonl"
+    ctl = ChaosController(
+        seed=1, rate=1.0, faults=("transient",), log_path=str(log)
+    )
+    with pytest.raises(ChaosTransientError):
+        ctl.transient("s1")
+    recs = [json.loads(l) for l in log.read_text().splitlines()]
+    assert recs and recs[0]["fault"] == "transient" and recs[0]["site"] == "s1"
+
+
+# ---------------------------------------------------------------------------
+# checkpoint crash consistency
+# ---------------------------------------------------------------------------
+
+
+def _two_saves(tmp_path):
+    ck = TrainingCheckpointer(str(tmp_path / "ck"), interval=1, async_save=False)
+    ck.save(0, {"round_tag": 0, "v": [1.0, 2.0]})
+    ck.save(1, {"round_tag": 1, "v": [3.0, 4.0]})
+    return ck
+
+
+def test_truncated_state_json_falls_back_to_old(tmp_path):
+    ck = _two_saves(tmp_path)
+    latest = os.path.join(ck.directory, "latest", "state.json")
+    with open(latest, "r+b") as f:
+        f.truncate(os.path.getsize(latest) // 2)
+    rnd, st = ck.load_latest()
+    assert rnd == 0 and st["round_tag"] == 0
+    assert ck.last_load_detail == {"round": 0, "source": ".ckpt-old", "fallback": True}
+
+
+def test_manifest_tamper_falls_back(tmp_path):
+    ck = _two_saves(tmp_path)
+    # byte-size matches but content differs -> sha256 catches it
+    latest = os.path.join(ck.directory, "latest", "state.json")
+    data = bytearray(open(latest, "rb").read())
+    data[-2] ^= 0xFF
+    with open(latest, "wb") as f:
+        f.write(data)
+    rnd, _ = ck.load_latest()
+    assert rnd == 0 and ck.last_load_detail["fallback"] is True
+
+
+def test_both_copies_corrupt_means_fresh_start(tmp_path):
+    ck = _two_saves(tmp_path)
+    for src in ("latest", ".ckpt-old"):
+        p = os.path.join(ck.directory, src, "state.json")
+        with open(p, "w") as f:
+            f.write("{not json")
+    assert ck.load_latest() is None
+
+
+def test_clean_load_reports_latest(tmp_path):
+    ck = _two_saves(tmp_path)
+    rnd, st = ck.load_latest()
+    assert rnd == 1 and st["round_tag"] == 1
+    assert ck.last_load_detail == {"round": 1, "source": "latest", "fallback": False}
+
+
+def test_chaos_ckpt_corrupt_self_heals(tmp_path):
+    """A chaos-torn 'latest' costs one interval, not the run."""
+    ck = TrainingCheckpointer(str(tmp_path / "ck"), interval=1, async_save=False)
+    ck.save(0, {"r": 0})
+    ctl = _chaos(seed=5, faults=("ckpt_corrupt",))  # tear only the 2nd save
+    ck.save(1, {"r": 1})
+    chaos.install(None)
+    assert ctl.fired
+    rnd, st = ck.load_latest()
+    assert rnd == 0 and st["r"] == 0
+    assert ck.last_load_detail["fallback"] is True
+
+
+# ---------------------------------------------------------------------------
+# guard policies end-to-end (chaos nan_grad on every family)
+# ---------------------------------------------------------------------------
+
+
+def test_gbm_clean_fit_identical_with_guard_on_and_off():
+    X, y = _data()
+    p_on = se.GBMRegressor(num_base_learners=4, scan_chunk=2).fit(X, y).predict(X)
+    p_off = (
+        se.GBMRegressor(num_base_learners=4, scan_chunk=2, on_nonfinite="off")
+        .fit(X, y)
+        .predict(X)
+    )
+    assert np.array_equal(np.asarray(p_on), np.asarray(p_off))
+
+
+@pytest.mark.parametrize("policy", ["skip_round", "halve_step", "stop_early"])
+def test_gbm_recovers_from_nan_round(policy):
+    X, y = _data()
+    ctl = _chaos(faults=("nan_grad",), budgets={"nan_grad": 1})
+    m = se.GBMRegressor(
+        num_base_learners=5, scan_chunk=2, on_nonfinite=policy
+    ).fit(X, y)
+    assert ctl.fired
+    p = np.asarray(m.predict(X))
+    assert np.all(np.isfinite(p))
+    if policy == "stop_early":
+        assert m.num_members < 5  # truncated to the last good round
+
+
+def test_gbm_default_policy_raises_with_round_attribution():
+    X, y = _data()
+    _chaos(faults=("nan_grad",), budgets={"nan_grad": 1})
+    with pytest.raises(NonFiniteError) as ei:
+        se.GBMClassifier(num_base_learners=4, scan_chunk=2).fit(
+            X, (y > 0).astype(np.float32)
+        )
+    assert ei.value.round_index is not None
+    assert ei.value.family == "GBMClassifier"
+
+
+def test_gbm_guard_emits_telemetry():
+    X, y = _data()
+    _chaos(faults=("nan_grad",), budgets={"nan_grad": 1})
+    with record_fits() as rec:
+        se.GBMRegressor(
+            num_base_learners=4, scan_chunk=2, on_nonfinite="skip_round"
+        ).fit(X, y)
+    evs = [e for e in rec.events if e["event"] == "guard_nonfinite"]
+    assert evs and evs[0]["action"] == "skip_round"
+
+
+def test_boosting_true_drops_poisoned_member():
+    X, y = _cls_data()
+    ctl = _chaos(faults=("nan_grad",), budgets={"nan_grad": 1})
+    # SAMME.R prediction ignores estimator weights, so the poisoned member
+    # must be DROPPED, not zero-weighted
+    m = se.BoostingClassifier(
+        num_base_learners=4, scan_chunk=2, algorithm="real",
+        on_nonfinite="skip_round",
+    ).fit(X, y)
+    assert ctl.fired
+    proba = np.asarray(m.predict_proba(X))
+    assert np.all(np.isfinite(proba))
+
+
+def test_bagging_drops_bad_members_and_scales_probabilities():
+    X, y = _cls_data()
+    _chaos(seed=21, faults=("nan_grad",), budgets={"nan_grad": 1})
+    m = se.BaggingClassifier(
+        num_base_learners=5, voting_strategy="soft", on_nonfinite="skip_round"
+    ).fit(X, y)
+    assert m.num_members == 4  # one member dropped
+    proba = np.asarray(m.predict_proba(X))
+    assert np.all(np.isfinite(proba))
+    # probabilities divide by the FITTED member count, not the param
+    assert np.allclose(proba.sum(axis=-1), 1.0, atol=1e-5)
+
+
+def test_stacking_drops_bad_member_keeps_consistent_layout():
+    X, y = _data()
+    _chaos(seed=31, faults=("nan_grad",), budgets={"nan_grad": 1})
+    m = se.StackingRegressor(on_nonfinite="skip_round").fit(X, y)
+    assert len(m.base_models) == 1  # one of the two defaults dropped
+    assert np.all(np.isfinite(np.asarray(m.predict(X))))
+
+
+def test_stacking_raise_is_default():
+    X, y = _data()
+    _chaos(seed=31, faults=("nan_grad",), budgets={"nan_grad": 1})
+    with pytest.raises(NonFiniteError):
+        se.StackingRegressor().fit(X, y)
+
+
+# ---------------------------------------------------------------------------
+# kill-and-resume equivalence
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "make_est",
+    [
+        lambda ckdir: se.GBMRegressor(
+            num_base_learners=6, scan_chunk=2,
+            checkpoint_dir=ckdir, checkpoint_interval=1,
+        ),
+        lambda ckdir: se.BoostingRegressor(
+            num_base_learners=6, scan_chunk=2,
+            checkpoint_dir=ckdir, checkpoint_interval=1,
+        ),
+    ],
+    ids=["gbm", "boosting"],
+)
+def test_kill_and_resume_matches_uninterrupted(tmp_path, make_est):
+    X, y = _data()
+    ref = make_est(None).fit(X, y)
+    p_ref = np.asarray(ref.predict(X))
+
+    est = make_est(str(tmp_path / "ck"))
+    _chaos(seed=3, faults=("preempt",), budgets={"preempt": 1})
+    with pytest.raises(ChaosPreemption):
+        est.fit(X, y)
+    chaos.install(None)
+
+    with record_fits() as rec:
+        m = est.fit(X, y)  # resumes from the checkpoint
+    resumes = [e for e in rec.events if e["event"] == "resume_from_checkpoint"]
+    assert resumes and resumes[0]["round"] >= 1
+    # deterministic replay: the resumed fit is bit-identical
+    assert np.array_equal(np.asarray(m.predict(X)), p_ref)
+
+
+# ---------------------------------------------------------------------------
+# fit_aborted terminal event
+# ---------------------------------------------------------------------------
+
+
+def test_fit_aborted_event_on_midfit_failure():
+    X, y = _data()
+    _chaos(seed=3, faults=("preempt",), budgets={"preempt": 1})
+    with record_fits() as rec:
+        with pytest.raises(ChaosPreemption):
+            se.GBMRegressor(num_base_learners=6, scan_chunk=2).fit(X, y)
+    aborted = [e for e in rec.events if e["event"] == "fit_aborted"]
+    assert len(aborted) == 1
+    ev = aborted[0]
+    assert ev["error_type"] == "ChaosPreemption"
+    assert ev["rounds"] >= 1  # rounds completed before the preemption
+    # the aborted stream has a terminal event but never a fit_end
+    fit_ids = {e["fit_id"] for e in aborted}
+    ends = [
+        e for e in rec.events
+        if e["event"] == "fit_end" and e["fit_id"] in fit_ids
+    ]
+    assert not ends
+
+
+def test_fit_aborted_on_validation_error_has_zero_rounds():
+    X, y = _data()
+    X[0, 0] = np.nan
+    with record_fits() as rec:
+        with pytest.raises(ValueError):
+            se.GBMRegressor(num_base_learners=2).fit(X, y)
+    aborted = [e for e in rec.events if e["event"] == "fit_aborted"]
+    # validation raises BEFORE telemetry starts: no stream, nothing to abort
+    assert aborted == [] or aborted[0]["rounds"] == 0
