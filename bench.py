@@ -609,6 +609,53 @@ def inner():
 
     train_acc = float(np.mean(np.asarray(model.predict(Xd)) == y))
 
+    # serving: the packed engine vs a raw per-request predict loop on a
+    # stream of small requests (the workload serving exists for).  Raw
+    # pays one dispatch + device->host fetch per request; the engine
+    # coalesces queued requests into bucket-sized dispatches.  The
+    # acceptance bar: engine >= raw, with ZERO compiles after warmup
+    # (counted via the jax.monitoring listener in telemetry.events).
+    from spark_ensemble_tpu.serving import InferenceEngine
+    from spark_ensemble_tpu.telemetry import record_fits
+
+    req_rows, num_reqs = 32, 300
+    reqs = [
+        np.asarray(X[(i * 101) % (X.shape[0] - req_rows) :][:req_rows])
+        for i in range(num_reqs)
+    ]
+    serve_rows = req_rows * num_reqs
+    for r in reqs[:4]:
+        np.asarray(model.predict(r))  # warm the raw path's bucket program
+    t0 = time.perf_counter()
+    for r in reqs:
+        np.asarray(model.predict(r))
+    raw_small_s = time.perf_counter() - t0
+
+    engine = InferenceEngine(
+        model, min_bucket=64, max_batch_size=4096, max_delay_ms=2.0
+    )
+    with record_fits() as rec:
+        t0 = time.perf_counter()
+        futs = [engine.submit(r) for r in reqs]
+        for f in futs:
+            f.result(timeout=300)
+        eng_small_s = time.perf_counter() - t0
+    lat = sorted(
+        e["latency_ms"]
+        for e in rec.events
+        if e["event"] == "request_served" and e["source"] == "queue"
+    )
+    # whole-dataset engine throughput (top-bucket chunked), warm
+    Xh = np.asarray(X)
+    engine.predict(Xh)
+    t0 = time.perf_counter()
+    engine.predict(Xh)
+    eng_bulk_s = time.perf_counter() - t0
+    serving_compiles = engine.stats()["compiles_since_warmup"]
+    engine.stop()
+    serving_rows_per_sec = serve_rows / eng_small_s
+    raw_small_rows_per_sec = serve_rows / raw_small_s
+
     # telemetry overhead: re-fit with the JSONL event stream enabled —
     # telemetry_path is not part of any program-cache key, so this fit
     # reuses the warmed programs and the delta is pure host-side
@@ -666,6 +713,18 @@ def inner():
         "telemetry_overhead_pct": round(telemetry_overhead_pct, 2),
         "telemetry_phase_shares": telemetry_phase_shares,
         "robustness_overhead_pct": round(robustness_overhead_pct, 2),
+        "serving_rows_per_sec": round(serving_rows_per_sec, 1),
+        "serving_raw_rows_per_sec": round(raw_small_rows_per_sec, 1),
+        "serving_vs_raw": round(
+            serving_rows_per_sec / max(raw_small_rows_per_sec, 1e-9), 3
+        ),
+        "serving_bulk_rows_per_sec": round(X.shape[0] / eng_bulk_s, 1),
+        "serving_queue_p50_ms": round(lat[len(lat) // 2], 3) if lat else None,
+        "serving_queue_p99_ms": (
+            round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 3)
+            if lat else None
+        ),
+        "serving_compiles_after_warmup": serving_compiles,
         "platform": platform,
         "device": str(jax.devices()[0]),
     }

@@ -108,6 +108,14 @@ from spark_ensemble_tpu.robustness import (
     retry_call,
     validate_fit_inputs,
 )
+from spark_ensemble_tpu import serving
+from spark_ensemble_tpu.serving import (
+    InferenceEngine,
+    ModelRegistry,
+    PackedModel,
+    load_packed,
+    pack,
+)
 from spark_ensemble_tpu.utils.persist import load
 
 __version__ = "0.1.0"
@@ -175,5 +183,10 @@ __all__ = [
     "RetryPolicy",
     "retry_call",
     "validate_fit_inputs",
+    "PackedModel",
+    "pack",
+    "load_packed",
+    "InferenceEngine",
+    "ModelRegistry",
     "load",
 ]

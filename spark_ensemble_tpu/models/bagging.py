@@ -333,10 +333,13 @@ class BaggingRegressionModel(RegressionModel, BaggingRegressor):
 
     def member_predictions(self, X):
         base = self._base()
-        fn = self._cached_jit(
-            "members", lambda members, Xq: base.predict_many_fn(members, Xq)
+        return self._predict_program(  # [m, n]
+            "members",
+            lambda members, Xq: base.predict_many_fn(members, Xq),
+            (self.params["members"],),
+            X,
+            out_row_axis=1,
         )
-        return fn(self.params["members"], as_f32(X))  # [m, n]
 
     def predict(self, X):
         return jnp.mean(self.member_predictions(X), axis=0)
@@ -424,32 +427,31 @@ class BaggingClassificationModel(ClassificationModel, BaggingClassifier):
         member-agreement/diversity assertions use these,
         `BaggingClassifierSuite.scala:80-155`)."""
         base = self._base()
-        fn = self._cached_jit(
-            "member_preds", lambda members, Xq: base.predict_many_fn(members, Xq)
+        return self._predict_program(
+            "member_preds",
+            lambda members, Xq: base.predict_many_fn(members, Xq),
+            (self.params["members"],),
+            X,
+            out_row_axis=1,
         )
-        return fn(self.params["members"], as_f32(X))
 
     def predict_raw(self, X):
         base = self._base()
         if self.voting_strategy.lower() == "soft":
-            fn = self._cached_jit(
-                "raw_soft",
-                lambda members, Xq: jnp.sum(
-                    base.predict_proba_many_fn(members, Xq), axis=0
-                ),
+            name, builder = "raw_soft", lambda members, Xq: jnp.sum(
+                base.predict_proba_many_fn(members, Xq), axis=0
             )
         else:
             k = self.num_classes
-            fn = self._cached_jit(
-                "raw_hard",
-                lambda members, Xq: jnp.sum(
-                    jax.nn.one_hot(
-                        base.predict_many_fn(members, Xq).astype(jnp.int32), k
-                    ),
-                    axis=0,
+            name, builder = "raw_hard", lambda members, Xq: jnp.sum(
+                jax.nn.one_hot(
+                    base.predict_many_fn(members, Xq).astype(jnp.int32), k
                 ),
+                axis=0,
             )
-        return fn(self.params["members"], as_f32(X))
+        return self._predict_program(
+            name, builder, (self.params["members"],), X
+        )
 
     def predict_proba(self, X):
         # reference raw2probabilityInPlace scales by 1/numModels

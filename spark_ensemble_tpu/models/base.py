@@ -32,6 +32,7 @@ drop weights, like `StackingClassifier.scala:147-150`.
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import OrderedDict
 from typing import Any, Callable, Optional
@@ -114,6 +115,62 @@ def cached_program(key: tuple, build: Callable[[], Callable]) -> Callable:
         while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_SIZE:
             _PROGRAM_CACHE.popitem(last=False)
     return fn
+
+
+# ---------------------------------------------------------------------------
+# predict-path shape bucketing (docs/serving.md)
+# ---------------------------------------------------------------------------
+#
+# Model predict programs are cached per instance by `_cached_jit`, but jit
+# still traces one program per distinct X.shape — so a caller feeding ad-hoc
+# batch sizes (a serving loop, CV folds of uneven length) silently recompiles
+# on every novel row count.  Every predict op here is row-independent, so
+# padding X up to a shared bucket and slicing the rows back out returns
+# bit-identical values for the real rows while collapsing the shape space to
+# O(log n) buckets.
+
+PREDICT_BUCKETS_ENV = "SE_TPU_PREDICT_BUCKETS"
+
+_BUCKET_POW2_EXACT = 512  # below this, plain next-power-of-two
+_BUCKET_OCTAVE_STEPS = 8  # above: pow2/8 granularity, <= 12.5% padding
+
+
+def predict_buckets_enabled() -> bool:
+    """Bucketing is on by default; ``SE_TPU_PREDICT_BUCKETS=0`` restores the
+    exact-shape behavior (one trace per novel row count)."""
+    return os.environ.get(PREDICT_BUCKETS_ENV, "") not in ("0", "off")
+
+
+def bucket_rows(n: int) -> int:
+    """Padded row count for a predict batch of ``n`` rows: the next power
+    of two for small batches, then steps of 1/8 of the power of two BELOW
+    ``n`` — padding stays <= 12.5% of ``n`` with 8 buckets per octave."""
+    n = int(n)
+    if n <= 1:
+        return 1
+    pow2 = 1 << (n - 1).bit_length()
+    if pow2 <= _BUCKET_POW2_EXACT:
+        return pow2
+    step = (pow2 // 2) // _BUCKET_OCTAVE_STEPS
+    return ((n + step - 1) // step) * step
+
+
+def pad_rows_to_bucket(X) -> jax.Array:
+    """``X`` as f32 with rows zero-padded up to ``bucket_rows(len(X))``.
+    Host inputs (numpy/lists — the serving boundary) pad in numpy so the
+    pad itself never compiles; device arrays pad with ``jnp.pad`` to stay
+    on device (a one-op compile per novel shape, cached thereafter)."""
+    n = np.shape(X)[0]
+    nb = bucket_rows(n)
+    if nb == n:
+        return as_f32(X)
+    if isinstance(X, jax.Array):
+        pad = [(0, nb - n)] + [(0, 0)] * (X.ndim - 1)
+        return jnp.pad(as_f32(X), pad)
+    Xa = np.asarray(X, np.float32)
+    buf = np.zeros((nb,) + Xa.shape[1:], np.float32)
+    buf[:n] = Xa
+    return jnp.asarray(buf)
 
 
 def mesh_fit_kwargs(estimator, mesh) -> dict:
@@ -289,6 +346,33 @@ class Model(Params):
         if key not in cache:
             cache[key] = jax.jit(builder)
         return cache[key]
+
+    def _predict_program(
+        self, name: str, builder, args: tuple, X, out_row_axis: int = 0
+    ) -> jax.Array:
+        """Run a cached predict program with X's rows padded to a shared
+        shape bucket (``bucket_rows``): every model predict entry point
+        routes through here so ad-hoc batch sizes hit one compiled program
+        per bucket instead of retracing per novel ``X.shape[0]``.  All
+        predict ops are row-independent, so the real rows' values are
+        bit-identical to an unpadded call; ``out_row_axis`` names the output
+        axis that carries rows (1 for ``[members, n]`` member stacks)."""
+        fn = self._cached_jit(name, builder)
+        n = np.shape(X)[0]
+        if not predict_buckets_enabled() or bucket_rows(n) == n:
+            return fn(*args, as_f32(X))
+        out = fn(*args, pad_rows_to_bucket(X))
+        index = (slice(None),) * out_row_axis + (slice(0, n),)
+        return out[index]
+
+    def pack(self):
+        """This model compacted for serving: a :class:`~spark_ensemble_tpu.
+        serving.export.PackedModel` — flat dict of stacked device arrays +
+        static metadata, save/load-able, bit-identical predictions
+        (docs/serving.md)."""
+        from spark_ensemble_tpu.serving.export import pack
+
+        return pack(self)
 
     def __getstate__(self):
         state = dict(self.__dict__)

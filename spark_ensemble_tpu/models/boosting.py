@@ -529,7 +529,7 @@ class BoostingClassificationModel(ClassificationModel, BoostingClassifier):
                 decisions = logp - jnp.mean(logp, axis=-1, keepdims=True)
                 return (k - 1.0) * jnp.sum(decisions, axis=0)
 
-            fn = self._cached_jit("raw_real", raw_real)
+            name, builder = "raw_real", raw_real
         else:
 
             def raw_discrete(members, weights, Xq):
@@ -538,8 +538,10 @@ class BoostingClassificationModel(ClassificationModel, BoostingClassifier):
                 votes = jnp.where(onehot > 0, 1.0, -1.0 / (k - 1.0))
                 return jnp.einsum("m,mnk->nk", weights, votes)
 
-            fn = self._cached_jit("raw_discrete", raw_discrete)
-        return fn(self.params["members"], self.params["weights"], as_f32(X))
+            name, builder = "raw_discrete", raw_discrete
+        return self._predict_program(
+            name, builder, (self.params["members"], self.params["weights"]), X
+        )
 
     def predict_proba(self, X):
         return jax.nn.softmax(self.predict_raw(X) / (self.num_classes - 1.0), axis=-1)
@@ -789,24 +791,42 @@ class BoostingRegressionModel(RegressionModel, BoostingRegressor):
 
     def member_predictions(self, X):
         base = self._base()
-        fn = self._cached_jit(
-            "members", lambda members, Xq: base.predict_many_fn(members, Xq)
+        return self._predict_program(  # [m, n]
+            "members",
+            lambda members, Xq: base.predict_many_fn(members, Xq),
+            (self.params["members"],),
+            X,
+            out_row_axis=1,
         )
-        return fn(self.params["members"], as_f32(X))  # [m, n]
 
     def predict(self, X):
         if self.num_members == 0:
             return jnp.zeros((as_f32(X).shape[0],), jnp.float32)
-        preds = self.member_predictions(X)
-        weights = self.params["weights"]
+        base = self._base()
+        # members + aggregation fused into ONE cached program so the whole
+        # predict path shape-buckets (the median's per-row vmap used to
+        # retrace on every novel n)
         if self.voting_strategy.lower() == "mean":
-            return jnp.einsum("m,mn->n", weights, preds) / jnp.maximum(
-                jnp.sum(weights), 1e-30
-            )
-        fn = self._cached_jit(
-            "median", jax.vmap(weighted_median, in_axes=(1, None))
+
+            def agg_mean(members, weights, Xq):
+                preds = base.predict_many_fn(members, Xq)
+                return jnp.einsum("m,mn->n", weights, preds) / jnp.maximum(
+                    jnp.sum(weights), 1e-30
+                )
+
+            name, builder = "predict_mean", agg_mean
+        else:
+
+            def agg_median(members, weights, Xq):
+                preds = base.predict_many_fn(members, Xq)
+                return jax.vmap(weighted_median, in_axes=(1, None))(
+                    preds, weights
+                )
+
+            name, builder = "predict_median", agg_median
+        return self._predict_program(
+            name, builder, (self.params["members"], self.params["weights"]), X
         )
-        return fn(preds, weights)
 
     def take(self, m: int) -> "BoostingRegressionModel":
         m = min(m, self.num_members)

@@ -1158,8 +1158,9 @@ class GBMRegressionModel(RegressionModel, GBMRegressor):
                 Xq, weights.shape[0], leaves,
             )
 
-        fn = self._cached_jit("predict", pred)
-        return out + fn(self.params["members"], self.params["weights"], X)
+        return out + self._predict_program(
+            "predict", pred, (self.params["members"], self.params["weights"]), X
+        )
 
     def take(self, k: int) -> "GBMRegressionModel":
         """Prefix model from the first k members (test harness parity with
@@ -1767,8 +1768,9 @@ class GBMClassificationModel(ClassificationModel, GBMClassifier):
 
             return predict_chunked_rows(one, Xq, r * dim, member_leaves(base))
 
-        fn = self._cached_jit("raw", raw)
-        return out + fn(self.params["members"], self.params["weights"], X)
+        return out + self._predict_program(
+            "raw", raw, (self.params["members"], self.params["weights"]), X
+        )
 
     def predict_raw(self, X):
         X = as_f32(X)

@@ -1,0 +1,41 @@
+"""Serving subsystem: packed export, bucketed AOT inference, model registry.
+
+The training side of this package ends at ``est.fit(X, y) -> model``; this
+subpackage is the inference side the ROADMAP's "serves heavy traffic" north
+star needs (the reference library stops at ``model.transform(df)`` — no
+export format, no batching, no warmup).  Three parts (docs/serving.md):
+
+- :mod:`spark_ensemble_tpu.serving.export` — ``pack(model)`` compacts any
+  fitted ensemble into a :class:`PackedModel` (flat dict of stacked device
+  arrays + static JSON metadata) with a versioned sha256-manifested on-disk
+  artifact and **bit-identical** predictions;
+- :mod:`spark_ensemble_tpu.serving.engine` — :class:`InferenceEngine` pads
+  requests into power-of-two batch buckets, AOT-compiles each bucket at
+  startup (``jax.jit(...).lower().compile()``), and serves synchronously or
+  through a micro-batching queue that coalesces many small callers into one
+  device dispatch;
+- :mod:`spark_ensemble_tpu.serving.registry` — :class:`ModelRegistry`, a
+  thread-safe multi-model registry with LRU eviction of device buffers.
+
+All three emit ``model_packed`` / ``engine_warmup`` / ``request_served``
+events through :mod:`spark_ensemble_tpu.telemetry`, so
+``tools/telemetry_report.py`` renders serving traces unchanged.
+"""
+
+from spark_ensemble_tpu.serving.export import (
+    PACKED_FORMAT_VERSION,
+    PackedModel,
+    load_packed,
+    pack,
+)
+from spark_ensemble_tpu.serving.engine import InferenceEngine
+from spark_ensemble_tpu.serving.registry import ModelRegistry
+
+__all__ = [
+    "PACKED_FORMAT_VERSION",
+    "PackedModel",
+    "pack",
+    "load_packed",
+    "InferenceEngine",
+    "ModelRegistry",
+]

@@ -1,0 +1,435 @@
+"""Bucketed, AOT-warmed batch-inference engine over a packed model.
+
+Serving traffic is many small requests of arbitrary row counts — the two
+things jit-compiled inference is worst at (every novel shape retraces; every
+tiny dispatch pays full launch overhead).  :class:`InferenceEngine` fixes
+both:
+
+- **Shape buckets**: requests are zero-padded into a fixed set of
+  power-of-two row buckets, so the shape space the compiler ever sees is
+  O(log max_batch) — and every bucket's program is AOT-compiled at startup
+  (``jax.jit(...).lower().compile()``), so steady-state serving performs
+  **zero** compiles (asserted in tests via the ``jax.monitoring`` compile
+  counters).  Padding is done host-side in numpy, so not even a one-op pad
+  program compiles per novel request size.
+- **Donated request buffers**: the padded request array is donated to the
+  compiled program (``donate_argnums``) on backends that support buffer
+  donation (not CPU), so serving allocates no second copy of the request.
+- **Micro-batching**: ``submit()`` returns a ``Future`` and a background
+  worker coalesces queued requests into one device dispatch, up to
+  ``max_batch_size`` rows or ``max_delay_ms`` of waiting — many small
+  callers share one program execution.
+
+Every request emits a ``request_served`` telemetry event (latency, rows,
+bucket, padding utilization, queue depth) through the existing telemetry
+sinks, and per-engine counters/histograms land in
+``telemetry.global_metrics()``.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_ensemble_tpu.serving.export import PackedModel, pack, rebuild_model
+from spark_ensemble_tpu.telemetry.events import (
+    _ensure_compile_listener,
+    compile_snapshot,
+    emit_event,
+    global_metrics,
+    serving_stream_id,
+)
+from spark_ensemble_tpu.utils.instrumentation import block_on_arrays
+
+__all__ = ["InferenceEngine"]
+
+_SHUTDOWN = object()
+
+
+def _pow2_buckets(min_bucket: int, max_bucket: int) -> Tuple[int, ...]:
+    out = []
+    b = 1 << max(0, int(min_bucket) - 1).bit_length()
+    while b < max_bucket:
+        out.append(b)
+        b <<= 1
+    out.append(1 << max(0, int(max_bucket) - 1).bit_length())
+    return tuple(sorted(set(out)))
+
+
+class _Request:
+    __slots__ = ("X", "n", "single", "future", "t_submit")
+
+    def __init__(self, X, n, single, future, t_submit):
+        self.X = X
+        self.n = n
+        self.single = single
+        self.future = future
+        self.t_submit = t_submit
+
+
+class InferenceEngine:
+    """Serve a fitted or packed model through fixed power-of-two batch
+    buckets with AOT-compiled programs and an optional micro-batching queue.
+
+    Parameters
+    ----------
+    model:
+        A fitted :class:`~spark_ensemble_tpu.models.base.Model` (packed
+        automatically) or a :class:`PackedModel`.
+    methods:
+        Model entry points to serve (``"predict"``, ``"predict_proba"``,
+        ``"predict_raw"``).  Every configured method is AOT-compiled for
+        every bucket at :meth:`warmup`; calling an unconfigured method
+        raises rather than silently compiling mid-serve.
+    min_bucket / max_batch_size:
+        Smallest and largest bucket row counts; buckets are the powers of
+        two spanning them.  Requests larger than the top bucket are served
+        in top-bucket chunks.
+    max_delay_ms:
+        Micro-batching window: how long the queue worker waits to coalesce
+        more requests once one is pending.
+    donate:
+        Donate the padded request buffer to the compiled program; default
+        on for backends with real donation support (not CPU).
+    warm:
+        AOT-compile + execute every (method, bucket) program at
+        construction; pass ``False`` to warm explicitly later.
+    """
+
+    def __init__(
+        self,
+        model,
+        *,
+        methods: Tuple[str, ...] = ("predict",),
+        min_bucket: int = 8,
+        max_batch_size: int = 4096,
+        max_delay_ms: float = 2.0,
+        donate: Optional[bool] = None,
+        warm: bool = True,
+        label: str = "engine",
+        telemetry_path: Optional[str] = None,
+    ):
+        self._packed = model if isinstance(model, PackedModel) else pack(model)
+        if self._packed.num_features <= 0:
+            raise ValueError(
+                "packed model reports no num_features; cannot size buckets"
+            )
+        self._methods = tuple(methods)
+        for m in self._methods:
+            if m not in ("predict", "predict_proba", "predict_raw"):
+                raise ValueError(f"unknown serve method {m!r}")
+        self._buckets = _pow2_buckets(min_bucket, max_batch_size)
+        self._max_batch = self._buckets[-1]
+        self._max_delay_s = float(max_delay_ms) / 1000.0
+        if donate is None:
+            donate = jax.default_backend() != "cpu"
+        self._donate = bool(donate)
+        self._label = label
+        self._telemetry_path = telemetry_path
+        self._stream = serving_stream_id(label)
+        self._lock = threading.Lock()
+        self._compiled: Dict[Tuple[str, int], Any] = {}
+        self._compile_s: Dict[Tuple[str, int], float] = {}
+        # engine programs close over nothing: the packed arrays are passed
+        # as arguments, snapshotted once here so the engine owns its device
+        # references (registry eviction offloads the PackedModel without
+        # yanking buffers out from under in-flight engines)
+        self._arrays = self._packed.device_arrays()
+        self._arrays_struct = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), self._arrays
+        )
+        self._metrics = global_metrics()
+        self._queue: "queue_mod.SimpleQueue" = queue_mod.SimpleQueue()
+        self._worker: Optional[threading.Thread] = None
+        self._stopped = False
+        _ensure_compile_listener()
+        self._warm_snapshot = compile_snapshot()
+        if warm:
+            self.warmup()
+
+    # -- compilation -------------------------------------------------------
+
+    @property
+    def buckets(self) -> Tuple[int, ...]:
+        return self._buckets
+
+    def bucket_for(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        return self._max_batch
+
+    def _compile(self, method: str, bucket: int):
+        key = (method, bucket)
+        with self._lock:
+            fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+        node = self._packed.node
+        d = self._packed.num_features
+
+        def run(arrays, X):
+            # rebuild happens at trace time only: model construction is
+            # pure pytree plumbing, so the whole model predict stages into
+            # ONE program with the packed arrays as (non-donated) inputs
+            return getattr(rebuild_model(node, arrays), method)(X)
+
+        jitted = jax.jit(run, donate_argnums=(1,) if self._donate else ())
+        t0 = time.perf_counter()
+        compiled = jitted.lower(
+            self._arrays_struct,
+            jax.ShapeDtypeStruct((bucket, d), jnp.float32),
+        ).compile()
+        compile_s = time.perf_counter() - t0
+        with self._lock:
+            won = self._compiled.setdefault(key, compiled)
+            if won is compiled:
+                self._compile_s[key] = compile_s
+        if won is compiled:
+            emit_event(
+                "engine_warmup",
+                path=self._telemetry_path,
+                fit_id=self._stream,
+                method=method,
+                bucket=int(bucket),
+                compile_s=compile_s,
+            )
+        return won
+
+    def warmup(self, methods: Optional[Tuple[str, ...]] = None) -> "InferenceEngine":
+        """AOT-compile every (method, bucket) program and execute each once
+        on zeros (touches allocator paths), then snapshot the compile
+        counters — ``stats()['compiles_since_warmup']`` counts from here."""
+        d = self._packed.num_features
+        for method in methods or self._methods:
+            for b in self._buckets:
+                compiled = self._compile(method, b)
+                out = compiled(
+                    self._arrays, jnp.zeros((b, d), jnp.float32)
+                )
+                block_on_arrays(out)
+        self._warm_snapshot = compile_snapshot()
+        return self
+
+    # -- synchronous serving ----------------------------------------------
+
+    def _normalize(self, X) -> Tuple[np.ndarray, bool]:
+        Xa = np.asarray(X, np.float32)
+        single = Xa.ndim == 1
+        if single:
+            Xa = Xa[None, :]
+        if Xa.ndim != 2 or Xa.shape[1] != self._packed.num_features:
+            raise ValueError(
+                f"request shape {np.shape(X)} does not match model "
+                f"num_features={self._packed.num_features}"
+            )
+        return Xa, single
+
+    def _run_padded(self, method: str, Xa: np.ndarray) -> np.ndarray:
+        """One compiled-program execution: host-side zero-pad to the bucket,
+        run, fetch, slice the real rows back out in numpy.  Nothing here
+        compiles on a warmed engine — pad AND slice stay on the host (even
+        an eager ``out[:n]`` would compile a one-op program per novel size),
+        which is what makes steady-state serving literally zero-compile."""
+        n = Xa.shape[0]
+        b = self.bucket_for(n)
+        compiled = self._compiled.get((method, b)) or self._compile(method, b)
+        if n < b:
+            buf = np.zeros((b, Xa.shape[1]), np.float32)
+            buf[:n] = Xa
+            Xa = buf
+        out = compiled(self._arrays, jnp.asarray(Xa))
+        return np.asarray(out)[:n], b
+
+    def _serve_rows(self, method: str, Xa: np.ndarray):
+        """Serve up to any row count: top-bucket chunks + one padded tail.
+        Returns host arrays — the serving boundary hands results back to
+        network/callers, so the device->host fetch happens exactly once."""
+        n = Xa.shape[0]
+        if n <= self._max_batch:
+            return self._run_padded(method, Xa)
+        outs = []
+        for i in range(0, n, self._max_batch):
+            out, _ = self._run_padded(method, Xa[i : i + self._max_batch])
+            outs.append(out)
+        return np.concatenate(outs, axis=0), self._max_batch
+
+    def _check_method(self, method: str):
+        if method not in self._methods:
+            raise ValueError(
+                f"engine was not configured to serve {method!r} "
+                f"(methods={self._methods}); construct with "
+                f"methods=(..., {method!r}) so it AOT-warms"
+            )
+
+    def _record(self, method: str, rows: int, bucket: int, latency_s: float,
+                queue_depth: int, batch_rows: int, source: str) -> None:
+        util = batch_rows / bucket if bucket else 0.0
+        emit_event(
+            "request_served",
+            path=self._telemetry_path,
+            fit_id=self._stream,
+            method=method,
+            rows=int(rows),
+            bucket=int(bucket),
+            batch_rows=int(batch_rows),
+            bucket_utilization=util,
+            latency_ms=latency_s * 1e3,
+            queue_depth=int(queue_depth),
+            source=source,
+        )
+        self._metrics.counter("serving/requests").inc()
+        self._metrics.counter("serving/rows").inc(int(rows))
+        self._metrics.histogram("serving/latency_ms").record(latency_s * 1e3)
+        self._metrics.histogram("serving/bucket_utilization").record(util)
+        self._metrics.gauge("serving/queue_depth").set(queue_depth)
+
+    def predict(self, X, method: str = "predict") -> np.ndarray:
+        """Synchronous bucketed inference -> host array; the result is
+        materialized before the latency is recorded, so
+        ``request_served.latency_ms`` is honest under async dispatch."""
+        self._check_method(method)
+        t0 = time.perf_counter()
+        Xa, single = self._normalize(X)
+        out, bucket = self._serve_rows(method, Xa)
+        self._record(
+            method, Xa.shape[0], bucket, time.perf_counter() - t0,
+            queue_depth=0, batch_rows=Xa.shape[0], source="sync",
+        )
+        return out[0] if single else out
+
+    def predict_proba(self, X) -> np.ndarray:
+        return self.predict(X, method="predict_proba")
+
+    def predict_raw(self, X) -> np.ndarray:
+        return self.predict(X, method="predict_raw")
+
+    # -- micro-batching queue ---------------------------------------------
+
+    def submit(self, X, method: str = "predict") -> Future:
+        """Queue a request; a background worker coalesces pending requests
+        into one device dispatch (up to ``max_batch_size`` rows or
+        ``max_delay_ms`` of waiting) and resolves each caller's Future with
+        its own rows."""
+        self._check_method(method)
+        if self._stopped:
+            raise RuntimeError("engine is stopped")
+        Xa, single = self._normalize(X)
+        fut: Future = Future()
+        req = _Request(Xa, Xa.shape[0], single, fut, time.perf_counter())
+        self._ensure_worker()
+        self._queue.put((method, req))
+        return fut
+
+    def _ensure_worker(self) -> None:
+        with self._lock:
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._worker_loop,
+                    name=f"se-tpu-{self._label}",
+                    daemon=True,
+                )
+                self._worker.start()
+
+    def _worker_loop(self) -> None:
+        while True:
+            try:
+                item = self._queue.get(timeout=0.2)
+            except queue_mod.Empty:
+                if self._stopped:
+                    return
+                continue
+            if item is _SHUTDOWN:
+                return
+            method, first = item
+            batch = [first]
+            rows = first.n
+            deadline = time.perf_counter() + self._max_delay_s
+            while rows < self._max_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    item = self._queue.get(timeout=remaining)
+                except queue_mod.Empty:
+                    break
+                if item is _SHUTDOWN:
+                    self._serve_batch(method, batch)
+                    return
+                nxt_method, req = item
+                if nxt_method != method:
+                    # method switch flushes the current coalesced batch
+                    self._serve_batch(method, batch)
+                    method, batch, rows = nxt_method, [req], req.n
+                    deadline = time.perf_counter() + self._max_delay_s
+                    continue
+                batch.append(req)
+                rows += req.n
+            self._serve_batch(method, batch)
+
+    def _serve_batch(self, method: str, batch: List[_Request]) -> None:
+        try:
+            depth = len(batch)
+            Xa = (
+                batch[0].X
+                if depth == 1
+                else np.concatenate([r.X for r in batch], axis=0)
+            )
+            out, bucket = self._serve_rows(method, Xa)
+            now = time.perf_counter()
+            offset = 0
+            for r in batch:
+                part = out[offset : offset + r.n]
+                offset += r.n
+                self._record(
+                    method, r.n, bucket, now - r.t_submit,
+                    queue_depth=depth, batch_rows=Xa.shape[0], source="queue",
+                )
+                r.future.set_result(part[0] if r.single else part)
+        except Exception as e:  # resolve every caller, never hang a Future
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)
+
+    # -- lifecycle / introspection ----------------------------------------
+
+    def stop(self) -> None:
+        """Drain and stop the queue worker (idempotent)."""
+        self._stopped = True
+        worker = self._worker
+        if worker is not None and worker.is_alive():
+            self._queue.put(_SHUTDOWN)
+            worker.join(timeout=5.0)
+
+    def __enter__(self) -> "InferenceEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def stats(self) -> Dict[str, Any]:
+        """Warmup + steady-state counters; ``compiles_since_warmup`` must
+        stay 0 on a warmed engine (the acceptance criterion the serving
+        tests and ``bench.py`` assert via ``jax.monitoring``)."""
+        c, s = compile_snapshot()
+        with self._lock:
+            compiled = {
+                f"{m}@{b}": self._compile_s.get((m, b))
+                for (m, b) in sorted(self._compiled)
+            }
+        return {
+            "buckets": self._buckets,
+            "methods": self._methods,
+            "donate": self._donate,
+            "compiled": compiled,
+            "compiles_since_warmup": c - self._warm_snapshot[0],
+            "compile_s_since_warmup": s - self._warm_snapshot[1],
+            "packed_bytes": self._packed.nbytes,
+        }

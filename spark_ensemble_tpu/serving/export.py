@@ -1,0 +1,415 @@
+"""Packed model export: flat device-array pytree + static metadata.
+
+``pack(model)`` compacts a fitted ensemble — bagging, boosting, GBM,
+stacking, including nested base-learner and init/stacker child models — into
+a :class:`PackedModel`: one flat ``{name: array}`` dict of the model's
+learned device arrays plus a JSON-able static spec (classes, config params,
+pytree structure).  The packed form is what the serving layer ships around:
+every array is addressable by name (manifests, byte accounting, host
+offload), nothing in it closes over live Python model objects, and the spec
+is versioned for on-disk round-trips.
+
+Bit-identity is the contract, not an aspiration: ``PackedModel`` serves
+predictions by REBUILDING the live model object from the very same arrays
+(lazily, cached), so packed inference runs the exact jitted programs the
+live model runs — same code path, same programs, bit-identical outputs.
+Save/load keeps the guarantee because ``.npz`` round-trips float bits
+losslessly.  The on-disk artifact follows the crash-consistency conventions
+of ``utils/checkpoint.py``: atomic tmpdir + rename, and a ``manifest.json``
+with per-file sha256 + byte size verified on load.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_ensemble_tpu.utils.checkpoint import _file_sha256
+from spark_ensemble_tpu.utils.persist import (
+    _CHILD_ATTRS,
+    _EXTRA_ATTRS,
+    _LIST_CHILD_ATTRS,
+    _class_registry,
+    _decode,
+)
+
+__all__ = ["PACKED_FORMAT_VERSION", "PackedModel", "pack", "load_packed"]
+
+PACKED_FORMAT_VERSION = 1
+_ARTIFACT_KIND = "spark_ensemble_tpu.packed"
+
+
+# ---------------------------------------------------------------------------
+# model <-> (static node spec, flat arrays) encoding
+# ---------------------------------------------------------------------------
+#
+# Same structural markers as utils/persist (__namedtuple__/__dict__/
+# __list__/__array__) so persist._decode reassembles the learned pytree —
+# but leaves stay as-is (device arrays keep their buffers; nothing round-
+# trips through host memory just to pack).
+
+
+def _flatten(obj: Any, arrays: Dict[str, Any], prefix: str):
+    if obj is None:
+        return None
+    if isinstance(obj, (bool, int, float, str)):
+        return obj
+    if hasattr(obj, "_fields"):  # NamedTuple (e.g. ops.tree.Tree)
+        return {
+            "__namedtuple__": type(obj).__name__,
+            "fields": {
+                f: _flatten(getattr(obj, f), arrays, f"{prefix}.{f}")
+                for f in obj._fields
+            },
+        }
+    if isinstance(obj, dict):
+        return {
+            "__dict__": {
+                k: _flatten(v, arrays, f"{prefix}.{k}") for k, v in obj.items()
+            }
+        }
+    if isinstance(obj, (list, tuple)):
+        return {
+            "__list__": [
+                _flatten(v, arrays, f"{prefix}.{i}") for i, v in enumerate(obj)
+            ],
+            "__tuple__": isinstance(obj, tuple),
+        }
+    arrays[prefix] = obj if isinstance(obj, jax.Array) else np.asarray(obj)
+    return {"__array__": prefix}
+
+
+def _encode_estimator(est) -> Optional[Dict[str, Any]]:
+    """Estimator config as a pure-JSON node: class name, scalar params, and
+    nested estimator-valued params (base_learner, stacker, ...) recursively
+    — the in-memory analogue of persist's nested ``learner/`` dirs."""
+    if est is None:
+        return None
+    node: Dict[str, Any] = {
+        "class": type(est).__name__,
+        "params": est.params_to_json_dict(),
+    }
+    estimators: Dict[str, Any] = {}
+    for name, p in est._param_defs().items():
+        if not p.is_estimator:
+            continue
+        value = getattr(est, name)
+        if value is None:
+            continue
+        if isinstance(value, (list, tuple)):
+            estimators[name] = {
+                "list": [_encode_estimator(v) for v in value]
+            }
+        else:
+            estimators[name] = {"one": _encode_estimator(value)}
+    if estimators:
+        node["estimators"] = estimators
+    return node
+
+
+def _decode_estimator(node, registry):
+    if node is None:
+        return None
+    cls = registry[node["class"]]
+    kwargs = dict(node["params"])
+    for name, spec in node.get("estimators", {}).items():
+        if "list" in spec:
+            kwargs[name] = [
+                _decode_estimator(v, registry) for v in spec["list"]
+            ]
+        else:
+            kwargs[name] = _decode_estimator(spec["one"], registry)
+    return cls(**kwargs)
+
+
+def _extra_attrs(model) -> Dict[str, Any]:
+    extra: Dict[str, Any] = {}
+    for attr in _EXTRA_ATTRS:
+        if hasattr(model, attr):
+            v = getattr(model, attr)
+            if isinstance(v, np.ndarray):
+                v = v.tolist()
+            extra[attr] = v
+    return extra
+
+
+def _encode_model(model, arrays: Dict[str, Any], prefix: str) -> Dict[str, Any]:
+    node = _encode_estimator(model)
+    node["learned"] = _flatten(model.params, arrays, f"{prefix}.p")
+    node["extra"] = _extra_attrs(model)
+    children = {}
+    for attr in _CHILD_ATTRS:
+        child = getattr(model, attr, None)
+        if child is not None:
+            children[attr] = _encode_model(child, arrays, f"{prefix}.{attr}")
+    if children:
+        node["children"] = children
+    list_children = {}
+    for attr in _LIST_CHILD_ATTRS:
+        kids = getattr(model, attr, None)
+        if kids:
+            list_children[attr] = [
+                _encode_model(c, arrays, f"{prefix}.{attr}{i}")
+                for i, c in enumerate(kids)
+            ]
+    if list_children:
+        node["list_children"] = list_children
+    return node
+
+
+def rebuild_model(node: Dict[str, Any], arrays: Dict[str, Any], registry=None):
+    """Live fitted model from a packed (node, arrays) pair.  Traceable:
+    construction only assigns pytrees, so the serving engine can call this
+    on traced array leaves to stage a whole-model predict program."""
+    if registry is None:
+        registry = _class_registry()
+    cls = registry[node["class"]]
+    kwargs = dict(node["params"])
+    for name, spec in node.get("estimators", {}).items():
+        if "list" in spec:
+            kwargs[name] = [
+                _decode_estimator(v, registry) for v in spec["list"]
+            ]
+        else:
+            kwargs[name] = _decode_estimator(spec["one"], registry)
+    kwargs["params"] = _decode(node["learned"], arrays, registry)
+    kwargs.update(node.get("extra", {}))
+    for attr, child in node.get("children", {}).items():
+        kwargs[attr] = rebuild_model(child, arrays, registry)
+    for attr, kids in node.get("list_children", {}).items():
+        kwargs[attr] = [rebuild_model(c, arrays, registry) for c in kids]
+    return cls(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# PackedModel
+# ---------------------------------------------------------------------------
+
+
+class PackedModel:
+    """A fitted ensemble compacted for serving: flat named device arrays +
+    static metadata, with live-model-bit-identical predictions.
+
+    ``predict``/``predict_proba``/``predict_raw`` delegate to a lazily
+    rebuilt live model over the SAME arrays, so they run the exact cached
+    XLA programs the original model runs.  ``save``/``load_packed`` write a
+    versioned directory artifact (``packed.json`` + ``arrays.npz`` +
+    sha256 ``manifest.json``).  ``offload()`` moves every array to host
+    memory and drops the live view — the registry's LRU eviction hook."""
+
+    def __init__(self, node: Dict[str, Any], arrays: Dict[str, Any]):
+        self._node = node
+        self._arrays = dict(arrays)
+        self._model = None
+        self._lock = threading.Lock()
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def node(self) -> Dict[str, Any]:
+        """Static metadata (JSON-able): classes, config, pytree spec."""
+        return self._node
+
+    @property
+    def class_name(self) -> str:
+        return self._node["class"]
+
+    @property
+    def num_features(self) -> int:
+        return int(self._node.get("extra", {}).get("num_features", 0))
+
+    @property
+    def num_classes(self) -> Optional[int]:
+        k = self._node.get("extra", {}).get("num_classes")
+        return None if k is None else int(k)
+
+    @property
+    def is_classifier(self) -> bool:
+        return self.num_classes is not None
+
+    # -- arrays ------------------------------------------------------------
+
+    @property
+    def array_names(self):
+        return sorted(self._arrays)
+
+    @property
+    def nbytes(self) -> int:
+        return int(sum(a.nbytes for a in self._arrays.values()))
+
+    def device_arrays(self) -> Dict[str, jax.Array]:
+        """The packed arrays as device arrays (no copy when already on
+        device) — the engine snapshots these once at construction so its
+        compiled programs keep their own buffer references."""
+        return {k: jnp.asarray(v) for k, v in self._arrays.items()}
+
+    def on_device(self) -> bool:
+        return any(isinstance(a, jax.Array) for a in self._arrays.values())
+
+    def ensure_device(self) -> "PackedModel":
+        with self._lock:
+            self._arrays = {
+                k: jnp.asarray(v) for k, v in self._arrays.items()
+            }
+        return self
+
+    def offload(self) -> "PackedModel":
+        """Move every packed array to host memory and drop the cached live
+        model (its jit cache holds device buffers); predictions still work
+        afterwards — arrays re-upload lazily on next use."""
+        with self._lock:
+            self._arrays = {
+                k: np.asarray(v) for k, v in self._arrays.items()
+            }
+            self._model = None
+        return self
+
+    # -- serving -----------------------------------------------------------
+
+    def model(self):
+        """The live fitted model rebuilt over the packed arrays (cached).
+        Same arrays + same model code = bit-identical predictions."""
+        with self._lock:
+            if self._model is None:
+                # re-upload in place: after offload() the arrays land back
+                # on device here, and the rebuilt model shares the buffers
+                self._arrays = {
+                    k: jnp.asarray(v) for k, v in self._arrays.items()
+                }
+                self._model = rebuild_model(self._node, dict(self._arrays))
+            return self._model
+
+    def predict(self, X) -> jax.Array:
+        return self.model().predict(X)
+
+    def predict_proba(self, X) -> jax.Array:
+        return self.model().predict_proba(X)
+
+    def predict_raw(self, X) -> jax.Array:
+        return self.model().predict_raw(X)
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Write the versioned artifact directory: ``packed.json`` (static
+        spec), ``arrays.npz`` (lossless float round-trip), and a
+        ``manifest.json`` with per-file sha256 + byte sizes — the same
+        crash-consistency conventions as ``utils/checkpoint.py`` (atomic
+        tmpdir + rename; a torn write can never look like an artifact)."""
+        from spark_ensemble_tpu import __version__
+
+        parent = os.path.dirname(os.path.abspath(path)) or "."
+        os.makedirs(parent, exist_ok=True)
+        tmp = tempfile.mkdtemp(dir=parent, prefix=".packed-tmp-")
+        try:
+            meta = {
+                "kind": _ARTIFACT_KIND,
+                "format_version": PACKED_FORMAT_VERSION,
+                "package_version": __version__,
+                "model": self._node,
+            }
+            with open(os.path.join(tmp, "packed.json"), "w") as f:
+                json.dump(meta, f, indent=2, default=float)
+            np.savez(
+                os.path.join(tmp, "arrays.npz"),
+                **{k: np.asarray(v) for k, v in self._arrays.items()},
+            )
+            manifest: Dict[str, Any] = {
+                "format_version": PACKED_FORMAT_VERSION,
+                "files": {},
+            }
+            for name in ("packed.json", "arrays.npz"):
+                p = os.path.join(tmp, name)
+                manifest["files"][name] = {
+                    "sha256": _file_sha256(p),
+                    "bytes": os.path.getsize(p),
+                }
+            with open(os.path.join(tmp, "manifest.json"), "w") as f:
+                json.dump(manifest, f, indent=2)
+            final = os.path.abspath(path)
+            if os.path.exists(final):
+                shutil.rmtree(final)
+            os.rename(tmp, final)
+        except Exception:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+
+    def __repr__(self):
+        return (
+            f"PackedModel({self.class_name}, arrays={len(self._arrays)}, "
+            f"bytes={self.nbytes})"
+        )
+
+
+def pack(model) -> PackedModel:
+    """Compact a fitted model into a :class:`PackedModel` (see module
+    docstring); emits a ``model_packed`` telemetry event."""
+    from spark_ensemble_tpu.models.base import Model
+    from spark_ensemble_tpu.telemetry.events import (
+        emit_event,
+        serving_stream_id,
+    )
+
+    if not isinstance(model, Model):
+        raise TypeError(
+            f"pack() expects a fitted Model; got {type(model).__name__} "
+            "(fit the estimator first)"
+        )
+    arrays: Dict[str, Any] = {}
+    node = _encode_model(model, arrays, "m")
+    packed = PackedModel(node, arrays)
+    emit_event(
+        "model_packed",
+        fit_id=serving_stream_id("pack"),
+        family=packed.class_name,
+        arrays=len(arrays),
+        bytes=packed.nbytes,
+        num_features=packed.num_features,
+    )
+    return packed
+
+
+def load_packed(path: str) -> PackedModel:
+    """Load a :func:`PackedModel.save` artifact, verifying the manifest
+    (sha256 + size per file) and the format version before touching any
+    payload — corruption and version skew fail loudly here, not as NaNs in
+    production predictions."""
+    mf_path = os.path.join(path, "manifest.json")
+    if not os.path.exists(mf_path):
+        raise FileNotFoundError(
+            f"{path!r} is not a packed-model artifact (no manifest.json)"
+        )
+    with open(mf_path) as f:
+        manifest = json.load(f)
+    for name, entry in manifest.get("files", {}).items():
+        p = os.path.join(path, name)
+        if not os.path.exists(p):
+            raise ValueError(f"packed artifact {path!r} is missing {name}")
+        if os.path.getsize(p) != entry["bytes"] or _file_sha256(p) != entry["sha256"]:
+            raise ValueError(
+                f"packed artifact {path!r}: {name} fails its manifest "
+                "checksum (truncated or corrupt write)"
+            )
+    with open(os.path.join(path, "packed.json")) as f:
+        meta = json.load(f)
+    if meta.get("kind") != _ARTIFACT_KIND:
+        raise ValueError(
+            f"{path!r} is not a packed-model artifact (kind={meta.get('kind')!r})"
+        )
+    version = int(meta.get("format_version", -1))
+    if version != PACKED_FORMAT_VERSION:
+        raise ValueError(
+            f"packed artifact {path!r} has format_version={version}; this "
+            f"build reads version {PACKED_FORMAT_VERSION}"
+        )
+    npz = os.path.join(path, "arrays.npz")
+    arrays = dict(np.load(npz)) if os.path.exists(npz) else {}
+    return PackedModel(meta["model"], arrays)

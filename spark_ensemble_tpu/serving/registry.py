@@ -1,0 +1,210 @@
+"""Multi-model serving registry with LRU eviction of device buffers.
+
+A serving process typically hosts more models than fit on the accelerator at
+once (per-tenant models, A/B variants, rollback generations).
+:class:`ModelRegistry` keeps every registered model's packed form resident in
+host memory and at most ``capacity`` of them *active* — live on device with
+a warmed :class:`InferenceEngine`.  Activating a model beyond capacity
+offloads the least-recently-used one: its engine (and the device buffers its
+compiled programs hold) is dropped and its :class:`PackedModel` arrays move
+back to host, to be re-uploaded and re-warmed on next use.
+
+Thread-safe throughout — request threads race on ``engine()``/``predict()``
+the way serving frontends do.  Evictions emit ``model_evicted`` telemetry
+events; per-model request events come from the engines themselves.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, Optional
+
+from spark_ensemble_tpu.serving.engine import InferenceEngine
+from spark_ensemble_tpu.serving.export import PackedModel, pack
+from spark_ensemble_tpu.telemetry.events import (
+    emit_event,
+    global_metrics,
+    serving_stream_id,
+)
+
+__all__ = ["ModelRegistry"]
+
+
+class _Entry:
+    __slots__ = ("packed", "engine", "opts", "hits", "activations", "last_used")
+
+    def __init__(self, packed: PackedModel, opts: Dict[str, Any]):
+        self.packed = packed
+        self.engine: Optional[InferenceEngine] = None
+        self.opts = opts
+        self.hits = 0
+        self.activations = 0
+        self.last_used = 0.0
+
+
+class ModelRegistry:
+    """Thread-safe name -> model registry serving through per-model
+    :class:`InferenceEngine` instances, keeping at most ``capacity`` models
+    device-resident (LRU eviction; see module docstring).
+
+    ``engine_opts`` (and per-``register`` overrides) are forwarded to every
+    :class:`InferenceEngine` the registry constructs."""
+
+    def __init__(
+        self,
+        capacity: int = 4,
+        *,
+        telemetry_path: Optional[str] = None,
+        **engine_opts,
+    ):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1; got {capacity}")
+        self._capacity = int(capacity)
+        self._telemetry_path = telemetry_path
+        self._engine_opts = dict(engine_opts)
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._stream = serving_stream_id("registry")
+        self._metrics = global_metrics()
+
+    # -- membership --------------------------------------------------------
+
+    def register(self, name: str, model, *, warm: bool = False, **engine_opts):
+        """Register a fitted model or :class:`PackedModel` under ``name``
+        (packing live models on the spot).  Registration is host-only by
+        default; pass ``warm=True`` to activate (device upload + AOT
+        warmup) immediately."""
+        packed = model if isinstance(model, PackedModel) else pack(model)
+        with self._lock:
+            if name in self._entries:
+                raise ValueError(
+                    f"model {name!r} is already registered (remove() first)"
+                )
+            opts = dict(self._engine_opts)
+            opts.update(engine_opts)
+            self._entries[name] = _Entry(packed, opts)
+        if warm:
+            self.engine(name)
+        return self
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            entry = self._entries.pop(name)
+        if entry.engine is not None:
+            entry.engine.stop()
+
+    def names(self):
+        with self._lock:
+            return list(self._entries)
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return name in self._entries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # -- serving -----------------------------------------------------------
+
+    def engine(self, name: str) -> InferenceEngine:
+        """The warmed engine for ``name`` (most-recently-used); activates
+        the model if offloaded and LRU-evicts over-capacity residents."""
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                raise KeyError(
+                    f"no model {name!r} registered "
+                    f"(registered: {sorted(self._entries)})"
+                )
+            self._entries.move_to_end(name)
+            entry.hits += 1
+            entry.last_used = time.time()
+            if entry.engine is None:
+                entry.packed.ensure_device()
+                entry.engine = InferenceEngine(
+                    entry.packed,
+                    warm=True,
+                    label=f"registry:{name}",
+                    telemetry_path=self._telemetry_path,
+                    **entry.opts,
+                )
+                entry.activations += 1
+                self._metrics.counter("serving/activations").inc()
+                self._evict_over_capacity()
+            return entry.engine
+
+    def predict(self, name: str, X, method: str = "predict"):
+        return self.engine(name).predict(X, method=method)
+
+    def submit(self, name: str, X, method: str = "predict"):
+        return self.engine(name).submit(X, method=method)
+
+    # -- eviction ----------------------------------------------------------
+
+    def _resident(self):
+        return [
+            (n, e) for n, e in self._entries.items() if e.engine is not None
+        ]
+
+    def _evict_over_capacity(self) -> None:
+        # called under self._lock; OrderedDict is LRU-ordered by move_to_end
+        resident = self._resident()
+        while len(resident) > self._capacity:
+            name, _ = resident.pop(0)
+            self._offload(name)
+
+    def _offload(self, name: str) -> None:
+        entry = self._entries[name]
+        engine, entry.engine = entry.engine, None
+        if engine is not None:
+            engine.stop()
+        freed = entry.packed.nbytes
+        entry.packed.offload()
+        self._metrics.counter("serving/evictions").inc()
+        emit_event(
+            "model_evicted",
+            path=self._telemetry_path,
+            fit_id=self._stream,
+            model=name,
+            bytes_freed=freed,
+        )
+
+    def evict(self, name: str) -> None:
+        """Explicitly offload ``name``'s device buffers (it stays
+        registered; next use re-activates)."""
+        with self._lock:
+            if name not in self._entries:
+                raise KeyError(f"no model {name!r} registered")
+            if self._entries[name].engine is not None:
+                self._offload(name)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        with self._lock:
+            for entry in self._entries.values():
+                if entry.engine is not None:
+                    entry.engine.stop()
+                    entry.engine = None
+
+    def __enter__(self) -> "ModelRegistry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def stats(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {
+                name: {
+                    "resident": e.engine is not None,
+                    "hits": e.hits,
+                    "activations": e.activations,
+                    "last_used": e.last_used,
+                    "bytes": e.packed.nbytes,
+                }
+                for name, e in self._entries.items()
+            }

@@ -17,8 +17,10 @@ from spark_ensemble_tpu.telemetry.events import (
     FitTelemetry,
     TelemetryRecorder,
     device_memory_stats,
+    emit_event,
     global_metrics,
     record_fits,
+    serving_stream_id,
 )
 
 __all__ = [
@@ -30,6 +32,8 @@ __all__ = [
     "FitTelemetry",
     "TelemetryRecorder",
     "device_memory_stats",
+    "emit_event",
     "global_metrics",
     "record_fits",
+    "serving_stream_id",
 ]

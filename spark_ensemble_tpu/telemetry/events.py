@@ -54,10 +54,22 @@ __all__ = [
     "record_fits",
     "device_memory_stats",
     "global_metrics",
+    "emit_event",
+    "serving_stream_id",
 ]
 
 TELEMETRY_ENV = "SE_TPU_TELEMETRY"
 PHASES_ENV = "SE_TPU_TELEMETRY_PHASES"
+
+# standalone (non-fit) event types emitted by the serving subsystem
+# (docs/serving.md): export compaction, per-bucket AOT warmup, per-request
+# service records.  docs/telemetry.md documents their fields.
+SERVING_EVENT_TYPES = (
+    "model_packed",
+    "engine_warmup",
+    "request_served",
+    "model_evicted",
+)
 
 # ---------------------------------------------------------------------------
 # process-global state: metrics registry, compile listener, recorder slot
@@ -209,6 +221,41 @@ def _append_jsonl(path: str, events: List[Dict[str, Any]]) -> None:
         with open(path, "a") as f:
             for line in lines:
                 f.write(line + "\n")
+
+
+# ---------------------------------------------------------------------------
+# standalone events (serving subsystem)
+# ---------------------------------------------------------------------------
+
+_STREAM_SEQ = itertools.count()
+
+
+def serving_stream_id(label: str = "serving") -> str:
+    """A fresh stream id in the same ``family:pid:seq`` shape as fit ids, so
+    ``tools/telemetry_report.py`` groups a serving session's events the way
+    it groups a fit's."""
+    return f"{label}:{os.getpid()}:{next(_STREAM_SEQ)}"
+
+
+def emit_event(event: str, path: Optional[str] = None, **fields) -> None:
+    """Emit one standalone structured event (``model_packed``,
+    ``engine_warmup``, ``request_served``, ...) through the same sinks as
+    fit telemetry: explicit ``path`` > ``SE_TPU_TELEMETRY`` env > the active
+    ``record_fits()`` recorder.  JSONL writes are immediate — serving
+    processes are long-running, so there is no fit-end flush to ride.
+    A no-op (nothing allocated past the sink check) when no sink is active.
+    """
+    path = path or os.environ.get(TELEMETRY_ENV) or None
+    recorder = _active_recorder()
+    if not path and recorder is None:
+        return
+    ev: Dict[str, Any] = {"event": event, "ts": time.time()}
+    ev.update(fields)
+    ev.setdefault("fit_id", "serving")
+    if recorder is not None:
+        recorder.record(ev)
+    if path:
+        _append_jsonl(path, [ev])
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,126 @@
+"""Cross-process serving smoke check (CI `serving` job; docs/serving.md).
+
+Two subcommands meant to run in SEPARATE processes, proving the packed
+artifact round-trips across a process restart:
+
+    python tools/serving_smoke.py export --out DIR
+        Fit a small GBM classifier on synthetic data, pack + save the
+        artifact to DIR/model, and save the live model's predictions to
+        DIR/expected.npz — the bit-exact expectations a fresh process must
+        reproduce.
+
+    python tools/serving_smoke.py serve --out DIR [--telemetry PATH]
+        Load the artifact (manifest-verified), assert the loaded
+        PackedModel's predictions are BIT-IDENTICAL to the exported
+        expectations, then serve through a warmed InferenceEngine (sync +
+        micro-batching queue) asserting tight allclose and zero compiles
+        after warmup.  Serving telemetry events go to PATH (JSONL).
+
+Exit code 0 = every assertion held; any mismatch raises.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _data(n=600, d=8, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    centers = rng.randn(3, d).astype(np.float32)
+    y = np.argmax(X @ centers.T, axis=1).astype(np.float32)
+    return X, y
+
+
+def cmd_export(args):
+    import spark_ensemble_tpu as se
+
+    X, y = _data()
+    model = se.GBMClassifier(num_base_learners=8).fit(X, y)
+    packed = model.pack()
+    packed.save(os.path.join(args.out, "model"))
+    np.savez(
+        os.path.join(args.out, "expected.npz"),
+        X=X,
+        predict=np.asarray(model.predict(X)),
+        proba=np.asarray(model.predict_proba(X)),
+    )
+    print(json.dumps({
+        "exported": os.path.join(args.out, "model"),
+        "arrays": len(packed.array_names),
+        "bytes": packed.nbytes,
+        "pid": os.getpid(),
+    }))
+
+
+def cmd_serve(args):
+    from spark_ensemble_tpu.serving import InferenceEngine, load_packed
+
+    expected = np.load(os.path.join(args.out, "expected.npz"))
+    X = expected["X"]
+    packed = load_packed(os.path.join(args.out, "model"))
+
+    # contract 1: the loaded artifact is bit-identical to the exporter's
+    # live model (same arrays -> same programs), across the restart
+    assert np.array_equal(np.asarray(packed.predict(X)), expected["predict"])
+    assert np.array_equal(
+        np.asarray(packed.predict_proba(X)), expected["proba"]
+    )
+
+    # contract 2: the warmed engine serves allclose results (whole-model
+    # fusion can move float rounding ~1 ulp) with ZERO compiles after
+    # warmup, sync and through the coalescing queue
+    engine = InferenceEngine(
+        packed,
+        methods=("predict", "predict_proba"),
+        max_batch_size=256,
+        telemetry_path=args.telemetry,
+    )
+    rng = np.random.RandomState(0)
+    for n in rng.randint(1, X.shape[0], size=20):
+        out = engine.predict(X[:n])
+        assert np.allclose(out, expected["predict"][:n], rtol=1e-5, atol=1e-6)
+    futs = [
+        (n, engine.submit(X[:n], method="predict_proba"))
+        for n in rng.randint(1, 64, size=40)
+    ]
+    for n, fut in futs:
+        assert np.allclose(
+            fut.result(timeout=60), expected["proba"][:n],
+            rtol=1e-5, atol=1e-6,
+        )
+    stats = engine.stats()
+    engine.stop()
+    assert stats["compiles_since_warmup"] == 0, stats
+    print(json.dumps({
+        "served_bit_identical": True,
+        "compiles_since_warmup": stats["compiles_since_warmup"],
+        "buckets": list(stats["buckets"]),
+        "pid": os.getpid(),
+        "telemetry": args.telemetry,
+    }))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_export = sub.add_parser("export")
+    p_export.add_argument("--out", required=True)
+    p_export.set_defaults(fn=cmd_export)
+    p_serve = sub.add_parser("serve")
+    p_serve.add_argument("--out", required=True)
+    p_serve.add_argument("--telemetry", default=None)
+    p_serve.set_defaults(fn=cmd_serve)
+    args = parser.parse_args(argv)
+    os.makedirs(args.out, exist_ok=True)
+    args.fn(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
