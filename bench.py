@@ -398,6 +398,7 @@ def _bench_full_extras():
             out["stacking_adult_mesh_error"] = str(e)[:200]
     else:
         out["stacking_adult_mesh_note"] = "single device; mesh placement moot"
+    out["full_autotune"] = _autotune_record()
     return out
 
 
@@ -438,6 +439,7 @@ def _bench_large_extras():
             "large_iters_per_sec": round(rounds / fit_s, 3),
             "large_fit_seconds": round(fit_s, 2),
             "large_config": f"synthetic n={n} d={d} k={k} rounds={rounds}",
+            "large_autotune": _autotune_record(n),
         }
         if platform != "cpu":
             # see inner(): MFU is only reported against a real chip's peak
@@ -511,6 +513,7 @@ def _bench_xl_extras():
             "xl_config": (
                 f"synthetic n={n} d={d} k={k} rounds={rounds} hist=stream"
             ),
+            "xl_autotune": _autotune_record(n),
         }
         if platform != "cpu":
             out["xl_mfu_est"] = round(
@@ -544,6 +547,24 @@ def _block_on_model(model):
     from spark_ensemble_tpu.utils.instrumentation import block_on_arrays
 
     block_on_arrays(model)
+
+
+def _autotune_record(n=None):
+    """The leg's resolved tuning state (docs/autotune.md): mode, whether a
+    cache entry applied, and the tunables that differ from their shipped
+    defaults — every bench leg records this so a number can always be
+    traced to the exact config that produced it."""
+    from spark_ensemble_tpu import autotune
+
+    snap = autotune.resolved_snapshot(n)
+    defaults = autotune.TUNABLES.defaults()
+    return {
+        "mode": snap["mode"],
+        "cache_hit": snap["cache_hit"],
+        "tuned": {
+            k: v for k, v in snap["values"].items() if v != defaults[k]
+        },
+    }
 
 
 def _timed_fit(est, X, y):
@@ -692,6 +713,35 @@ def inner():
     except (OSError, json.JSONDecodeError):
         pass
 
+    # tuned-vs-default (docs/autotune.md): the headline above resolved
+    # every tunable through the published tuning cache (when one exists
+    # for this device); re-measure the same fit + predict with autotuning
+    # OFF — every site at its shipped default literal.  >1.0 means the
+    # measured winners genuinely beat the hand-guessed constants.  The
+    # program caches clear on both edges: trace-time tunables are latched
+    # into compiled programs, so each leg must trace under its own config.
+    from spark_ensemble_tpu import autotune as _autotune
+
+    autotune_state = _autotune_record(X.shape[0])
+    with _autotune.override(mode="off"):
+        _autotune.clear_program_caches()
+        est_def = est.copy()
+        est_def.fit(X, y)  # warm at the SAME round count (see above)
+        model_def, def_fit_s = _timed_fit(est_def, X, y)
+        jax.block_until_ready(model_def.predict(Xd))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out_def = model_def.predict(Xd)
+        jax.block_until_ready(out_def)
+        def_pred_s = (time.perf_counter() - t0) / reps
+    _autotune.clear_program_caches()  # later legs re-trace under live config
+    tuned_vs_default = {
+        "fit": round(def_fit_s / fit_s, 3),
+        "predict": round(def_pred_s / pred_s, 3),
+        "default_fit_seconds": round(def_fit_s, 2),
+        "default_predict_rows_per_sec": round(X.shape[0] / def_pred_s, 1),
+    }
+
     platform = jax.devices()[0].platform
 
     # emit the HEADLINE result immediately (flushed): the parent takes the
@@ -725,6 +775,8 @@ def inner():
             if lat else None
         ),
         "serving_compiles_after_warmup": serving_compiles,
+        "autotune": autotune_state,
+        "tuned_vs_default": tuned_vs_default,
         "platform": platform,
         "device": str(jax.devices()[0]),
     }

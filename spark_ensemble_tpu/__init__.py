@@ -116,6 +116,14 @@ from spark_ensemble_tpu.serving import (
     load_packed,
     pack,
 )
+from spark_ensemble_tpu import autotune
+from spark_ensemble_tpu.autotune import (
+    TUNABLES,
+    TuningCache,
+    autotune_fit,
+    enable_compilation_cache,
+    run_search,
+)
 from spark_ensemble_tpu.utils.persist import load
 
 __version__ = "0.1.0"
@@ -188,5 +196,10 @@ __all__ = [
     "load_packed",
     "InferenceEngine",
     "ModelRegistry",
+    "TUNABLES",
+    "TuningCache",
+    "autotune_fit",
+    "enable_compilation_cache",
+    "run_search",
     "load",
 ]

@@ -1,0 +1,80 @@
+"""Autotuned execution engine: measured config selection for the
+hot-path constants, plus the persistent compilation cache.
+
+The package replaces three rounds of hand-guessed launch/tiling
+literals with a measurement loop (docs/autotune.md):
+
+- :mod:`~spark_ensemble_tpu.autotune.space` — the typed tunable space
+  (``TUNABLES``) whose defaults mirror the shipped literals;
+- :mod:`~spark_ensemble_tpu.autotune.search` — a deterministic search
+  (``run_search`` / ``autotune_fit`` / the ``tools/autotune.py`` CLI)
+  timing real jitted dispatches via the telemetry ``RoundTimer``;
+- :mod:`~spark_ensemble_tpu.autotune.cache` — a versioned on-disk
+  winner cache keyed by ``(platform, device_kind, shape_class)`` with
+  sha256 manifest + atomic publish (``SE_TPU_AUTOTUNE_CACHE``);
+- :mod:`~spark_ensemble_tpu.autotune.resolve` — transparent lookup at
+  fit/serve time, gated by ``SE_TPU_AUTOTUNE=off|cache|search`` with
+  hand-set estimator params always winning and bit-identical behavior
+  when off or unpopulated;
+- :mod:`~spark_ensemble_tpu.autotune.compilation_cache` — JAX
+  persistent compilation cache wiring (``SE_TPU_COMPILE_CACHE``), so
+  repeated processes (serving restarts, CI jobs) stop re-compiling.
+"""
+
+from spark_ensemble_tpu.autotune.cache import (
+    CACHE_ENV,
+    CACHE_VERSION,
+    TuningCache,
+    cache_dir,
+)
+from spark_ensemble_tpu.autotune.compilation_cache import (
+    COMPILE_CACHE_ENV,
+    compilation_cache_dir,
+    enable_compilation_cache,
+    ensure_compilation_cache,
+)
+from spark_ensemble_tpu.autotune.resolve import (
+    MODE_ENV,
+    autotune_mode,
+    fingerprint,
+    override,
+    reset,
+    resolve,
+    resolved_snapshot,
+)
+from spark_ensemble_tpu.autotune.search import (
+    autotune_fit,
+    clear_program_caches,
+    run_search,
+)
+from spark_ensemble_tpu.autotune.space import (
+    TUNABLES,
+    Tunable,
+    TunableSpace,
+    shape_class,
+)
+
+__all__ = [
+    "CACHE_ENV",
+    "CACHE_VERSION",
+    "COMPILE_CACHE_ENV",
+    "MODE_ENV",
+    "TUNABLES",
+    "Tunable",
+    "TunableSpace",
+    "TuningCache",
+    "autotune_fit",
+    "autotune_mode",
+    "cache_dir",
+    "clear_program_caches",
+    "compilation_cache_dir",
+    "enable_compilation_cache",
+    "ensure_compilation_cache",
+    "fingerprint",
+    "override",
+    "reset",
+    "resolve",
+    "resolved_snapshot",
+    "run_search",
+    "shape_class",
+]

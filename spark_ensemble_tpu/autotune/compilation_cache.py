@@ -1,0 +1,91 @@
+"""JAX persistent compilation cache wiring.
+
+Every fresh process re-traces and re-compiles the same XLA programs —
+the serving engine's warmup and the CI jobs were cold on every run.
+JAX ships a persistent on-disk compilation cache; this module turns it
+on with thresholds suited to this package (many sub-second CPU
+compiles, which the stock 1-second minimum would refuse to persist).
+
+Activation is transparent: ``cached_program`` (every fit/predict
+program build) and ``InferenceEngine.warmup`` call
+:func:`ensure_compilation_cache`, which is a no-op unless
+``SE_TPU_COMPILE_CACHE=<dir>`` is set — or code calls
+:func:`enable_compilation_cache` with an explicit path.  CI exports the
+env var and persists the directory as an actions cache, so the second
+run of any job loads compiled executables instead of re-compiling
+(verified by the serving job's zero-warmup-compile assertion).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional
+
+logger = logging.getLogger("spark_ensemble_tpu")
+
+COMPILE_CACHE_ENV = "SE_TPU_COMPILE_CACHE"
+
+_LOCK = threading.Lock()
+_ENABLED_DIR: Optional[str] = None
+
+
+def enable_compilation_cache(path: str) -> bool:
+    """Point JAX's persistent compilation cache at ``path`` (created if
+    missing) and lower the persistence thresholds so even fast CPU
+    compiles are cached.  Idempotent; returns True when active."""
+    global _ENABLED_DIR
+    with _LOCK:
+        if _ENABLED_DIR is not None:
+            return True
+        try:
+            import jax
+
+            os.makedirs(path, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", path)
+            # stock minimums (1s compile, 0-byte entries) skip most of
+            # this package's programs on CPU; cache everything
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+            # jax latches its cache state at the FIRST compile of the
+            # process; if anything compiled before this call (e.g. data
+            # prep ahead of the first program build), the backing store
+            # latched to None and every later read/write is silently
+            # skipped despite the config dir above.  Un-latch so the next
+            # compile re-initializes against the configured directory.
+            try:
+                from jax._src import compilation_cache as _jcc
+
+                if (
+                    getattr(_jcc, "_cache_initialized", False)
+                    and getattr(_jcc, "_cache", None) is None
+                ):
+                    _jcc.reset_cache()
+            except Exception:  # noqa: BLE001 - private API moved
+                pass
+            _ENABLED_DIR = path
+            logger.info("persistent compilation cache enabled at %s", path)
+            return True
+        except Exception:  # noqa: BLE001 - older jax / readonly fs
+            logger.warning(
+                "could not enable the persistent compilation cache at %s",
+                path, exc_info=True,
+            )
+            return False
+
+
+def ensure_compilation_cache() -> bool:
+    """Enable the cache from ``SE_TPU_COMPILE_CACHE`` if set; cheap
+    no-op otherwise.  Called on every program build and serving warmup."""
+    if _ENABLED_DIR is not None:
+        return True
+    path = os.environ.get(COMPILE_CACHE_ENV, "").strip()
+    if not path:
+        return False
+    return enable_compilation_cache(path)
+
+
+def compilation_cache_dir() -> Optional[str]:
+    """The active persistent-cache directory, or ``None``."""
+    return _ENABLED_DIR

@@ -1,0 +1,198 @@
+"""Transparent resolution of tunables at fit/serve time.
+
+Hot-path sites call ``resolve(name, default, n=...)`` with their live
+module constant as the default.  Resolution order (first hit wins):
+
+1. an active :func:`override` (bench/search in-process toggles);
+2. ``SE_TPU_AUTOTUNE=off`` -> the default, always (bit-identity escape
+   hatch; hand-set estimator params never reach resolve at all — the
+   call sites skip it when the user set the param explicitly);
+3. the on-disk cache entry for ``(platform, device_kind, shape_class)``
+   (mode ``cache``, the default, and ``search``);
+4. under mode ``search`` with no entry for this device: a one-shot
+   in-process smoke search populates the cache first;
+5. the default.
+
+The loaded cache is memoized per directory and re-validated by a single
+``stat`` of the published manifest per call, so resolve is cheap enough
+for per-request sites (the predict bucket ladder).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, Optional, Tuple
+
+from spark_ensemble_tpu.autotune.cache import (
+    TuningCache,
+    cache_dir,
+    manifest_signature,
+)
+from spark_ensemble_tpu.autotune.space import TUNABLES, shape_class
+
+logger = logging.getLogger("spark_ensemble_tpu")
+
+MODE_ENV = "SE_TPU_AUTOTUNE"
+_MODES = ("off", "cache", "search")
+
+# override stack (bench tuned-vs-default legs, the search's candidate
+# sweeps, tests).  Deliberately process-global, not thread-local: a
+# worker thread dispatching a candidate program must see the candidate.
+_OVERRIDES: list = []
+
+# memoized cache view: {dir: (manifest_signature, TuningCache)}
+_LOADED: Dict[str, Tuple[Any, TuningCache]] = {}
+_LOAD_LOCK = threading.Lock()
+
+# re-entrancy guard for mode="search" auto-tuning (the search itself
+# fits models, whose hot paths call resolve)
+_IN_SEARCH = threading.local()
+
+
+def autotune_mode() -> str:
+    """Active mode: the innermost ``override(mode=...)`` if any, else
+    ``SE_TPU_AUTOTUNE`` (default ``cache``)."""
+    for frame in reversed(_OVERRIDES):
+        if frame.get("mode") is not None:
+            return frame["mode"]
+    raw = os.environ.get(MODE_ENV, "").strip().lower()
+    if not raw:
+        return "cache"
+    if raw not in _MODES:
+        logger.warning(
+            "%s=%r is not one of %s; treating as 'off'", MODE_ENV, raw, _MODES
+        )
+        return "off"
+    return raw
+
+
+@contextmanager
+def override(mode: Optional[str] = None, **params):
+    """Force tunables (and/or the mode) for a scope — used by the search
+    to dispatch candidate configs and by bench's tuned-vs-default leg.
+    Overridden params win over the cache; unknown names raise."""
+    unknown = [k for k in params if k not in TUNABLES]
+    if unknown:
+        raise ValueError(f"unknown tunables: {unknown}")
+    if mode is not None and mode not in _MODES:
+        raise ValueError(f"mode must be one of {_MODES}; got {mode!r}")
+    frame = {"mode": mode, "params": params}
+    _OVERRIDES.append(frame)
+    try:
+        yield
+    finally:
+        _OVERRIDES.remove(frame)
+
+
+def reset() -> None:
+    """Drop the memoized cache view (tests that swap cache dirs/content
+    mid-process get a clean reload; normal use never needs this — the
+    manifest stat re-validates automatically)."""
+    with _LOAD_LOCK:
+        _LOADED.clear()
+
+
+def _device_identity() -> Tuple[str, str]:
+    import jax
+
+    try:
+        dev = jax.devices()[0]
+        return jax.default_backend(), getattr(dev, "device_kind", dev.platform)
+    except Exception:  # noqa: BLE001 - no backend at all
+        return "cpu", "cpu"
+
+
+def _load() -> TuningCache:
+    d = cache_dir()
+    sig = manifest_signature(d)
+    with _LOAD_LOCK:
+        memo = _LOADED.get(d)
+        if memo is not None and memo[0] == sig:
+            return memo[1]
+    cache = TuningCache.load(d) if sig is not None else TuningCache()
+    with _LOAD_LOCK:
+        _LOADED[d] = (sig, cache)
+    return cache
+
+
+def _maybe_search() -> None:
+    """Mode ``search`` with an empty cache for this device: run the smoke
+    search once, then serve from the cache like mode ``cache``."""
+    if getattr(_IN_SEARCH, "active", False):
+        return
+    platform, kind = _device_identity()
+    if _load().entries and any(
+        k.startswith(f"{platform}/") for k in _load().entries
+    ):
+        return
+    _IN_SEARCH.active = True
+    try:
+        from spark_ensemble_tpu.autotune.search import run_search
+
+        logger.info(
+            "SE_TPU_AUTOTUNE=search and no tuned entries for %s/%s: "
+            "running the smoke search once", platform, kind,
+        )
+        run_search(budget="smoke")
+        reset()
+    except Exception:  # noqa: BLE001 - tuning must never break a fit
+        logger.warning("in-process autotune search failed", exc_info=True)
+    finally:
+        _IN_SEARCH.active = False
+
+
+def resolve(name: str, default, *, n: Optional[int] = None):
+    """The tuned value for ``name`` at this site, or ``default``.
+
+    ``default`` is the caller's LIVE module constant (read at call time,
+    so test monkeypatching of the source literal keeps working); ``n``
+    is the row count when the site knows one (selects the shape class).
+    """
+    for frame in reversed(_OVERRIDES):
+        if name in frame["params"]:
+            return frame["params"][name]
+    mode = autotune_mode()
+    if mode == "off":
+        return default
+    if mode == "search":
+        _maybe_search()
+    platform, kind = _device_identity()
+    params = _load().lookup(platform, kind, shape_class(n))
+    return params.get(name, default)
+
+
+def fingerprint() -> tuple:
+    """Tuning-state token appended to jitted-program cache keys: programs
+    traced under different tuned configs (cache generations, override
+    frames, modes) must never collide.  Cheap — one env read and one
+    manifest stat."""
+    mode = autotune_mode()
+    if mode == "off" and not _OVERRIDES:
+        return ("autotune-off",)
+    over = tuple(
+        (k, v) for frame in _OVERRIDES for k, v in frame["params"].items()
+    )
+    return (mode, manifest_signature(), over)
+
+
+def resolved_snapshot(n: Optional[int] = None) -> Dict[str, Any]:
+    """Every tunable's resolved value at this site plus the mode and
+    whether any cache entry applied — bench records this in each leg."""
+    mode = autotune_mode()
+    platform, kind = _device_identity()
+    if mode == "off":
+        tuned: Dict[str, Any] = {}
+    else:
+        tuned = _load().lookup(platform, kind, shape_class(n))
+    values = {
+        t.name: resolve(t.name, t.default, n=n) for t in TUNABLES
+    }
+    return {
+        "mode": mode,
+        "cache_hit": bool(tuned),
+        "cache_dir": cache_dir(),
+        "values": values,
+    }

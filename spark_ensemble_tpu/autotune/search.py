@@ -1,0 +1,431 @@
+"""Deterministic measured search over the tunable space.
+
+The search times REAL jitted dispatches — a letter-shaped GBM fit, its
+full-batch predict, the stream histogram tier, a mixed-size predict
+request stream — under each candidate config, fenced through the
+telemetry ``RoundTimer`` so async dispatch cannot fake a win.  Winners
+land in the on-disk :class:`~spark_ensemble_tpu.autotune.cache.TuningCache`
+keyed by ``(platform, device_kind, shape_class)`` and are consulted
+transparently at fit/serve time (autotune.resolve).
+
+Determinism: fixed-seed synthetic data, a fixed candidate order, and a
+winner rule of "min median time, but only if it beats the default by
+more than the noise floor" — so re-running the search on the same
+machine converges instead of flapping.  Tests inject a fake ``measure``
+callable for bit-deterministic winner selection.
+
+Entry points: :func:`run_search` (the ``tools/autotune.py`` CLI body)
+and :func:`autotune_fit` (the in-process fast path: tune for an actual
+estimator + dataset, short-circuiting when the cache already covers
+this device and shape class).
+"""
+
+from __future__ import annotations
+
+import logging
+import statistics
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from spark_ensemble_tpu.autotune.cache import TuningCache
+from spark_ensemble_tpu.autotune.resolve import (
+    _device_identity,
+    override,
+    reset,
+)
+from spark_ensemble_tpu.autotune.space import TUNABLES, shape_class
+
+logger = logging.getLogger("spark_ensemble_tpu")
+
+# only winners beating the default config by more than this fraction are
+# recorded — below it the measured spread is timing noise, and recording
+# it would make back-to-back searches flap between near-ties
+NOISE_FLOOR = 0.02
+
+# (n, d, k, rounds, repeats, max_depth, max_bins) per budget; "full" is
+# letter-shaped (same shape class the bench headline leg resolves)
+BUDGETS: Dict[str, Dict[str, int]] = {
+    "smoke": dict(n=2048, d=8, k=4, rounds=6, repeats=1, depth=4, bins=32),
+    "fast": dict(n=8192, d=16, k=8, rounds=16, repeats=2, depth=5, bins=64),
+    "full": dict(n=15000, d=16, k=26, rounds=24, repeats=3, depth=5, bins=64),
+}
+
+_GROUPS = ("fit", "predict", "stream", "bucket", "pallas")
+
+
+def clear_program_caches() -> None:
+    """Drop every jitted/compiled program so the next dispatch re-traces
+    under the CURRENT tuned config.  Trace-time tunables (stream chunk,
+    fused-cell budgets, the hist tier) are latched into programs at
+    trace time; candidate sweeps and tuned-vs-default comparisons must
+    clear between configs or they time a stale program."""
+    import jax
+
+    from spark_ensemble_tpu.models import base as model_base
+
+    with model_base._PROGRAM_CACHE_LOCK:
+        model_base._PROGRAM_CACHE.clear()
+    jax.clear_caches()
+
+
+def _measure_real(tag: Dict[str, Any], thunk: Callable[[], Any],
+                  repeats: int) -> float:
+    """Median fenced wall time of ``thunk`` over ``repeats`` runs, after
+    one untimed warmup (compiles excluded — steady-state cost is what
+    the tuned constants control)."""
+    from spark_ensemble_tpu.telemetry.events import global_metrics
+    from spark_ensemble_tpu.telemetry.registry import RoundTimer
+
+    timer = RoundTimer(
+        "autotune/measure", global_metrics().histogram("autotune/measure_s")
+    )
+    thunk()  # warmup: compile + first dispatch
+    times = []
+    for _ in range(max(repeats, 1)):
+        timer.start()
+        out = thunk()
+        times.append(timer.stop(out))
+    return statistics.median(times)
+
+
+def _synth_classification(n: int, d: int, k: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic learnable k-class data (fixed seed: the search must
+    measure the same programs every run)."""
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    W = rng.standard_normal((d, k)).astype(np.float32)
+    logits = X @ W + 0.5 * rng.standard_normal((n, k)).astype(np.float32)
+    y = np.argmax(logits, axis=1).astype(np.int32)
+    return X, y
+
+
+def _candidate_rows(values, default) -> List[Any]:
+    """Default first (its time is the comparison floor), then the rest in
+    declared order."""
+    rest = [v for v in values if v != default]
+    return [default] + rest
+
+
+def _sweep(
+    name: str,
+    candidates: List[Any],
+    make_thunk: Callable[[], Callable[[], Any]],
+    measure: Callable,
+    repeats: int,
+    timings: Dict[str, Dict[str, float]],
+    extra_override: Optional[Dict[str, Any]] = None,
+    real: bool = True,
+) -> Tuple[Any, float, float]:
+    """Time every candidate for one tunable; returns (winner, win_time,
+    default_time).  ``make_thunk`` builds a fresh workload closure per
+    candidate (program caches are cleared under the candidate override
+    when measuring for real)."""
+    default = candidates[0]
+    results: Dict[Any, float] = {}
+    for cand in candidates:
+        ov = dict(extra_override or {})
+        ov[name] = cand
+        with override(mode="cache", **ov):
+            if real:
+                clear_program_caches()
+            thunk = make_thunk()
+            t = measure({"tunable": name, "candidate": cand}, thunk, repeats)
+        results[cand] = t
+        logger.info("autotune %s=%r: %.4fs", name, cand, t)
+    timings[name] = {str(c): results[c] for c in candidates}
+    best = min(results, key=lambda c: (results[c], str(c) != str(default)))
+    if results[best] >= results[default] * (1.0 - NOISE_FLOOR):
+        best = default  # not convincingly better than shipped default
+    return best, results[best], results[default]
+
+
+def run_search(
+    budget: str = "smoke",
+    *,
+    groups: Optional[Tuple[str, ...]] = None,
+    measure: Optional[Callable] = None,
+    save: bool = True,
+    directory: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Run the measured search; returns ``{"winners", "timings",
+    "platform", "device_kind", "shape_class", "budget"}`` and (when
+    ``save``) publishes winners to the on-disk cache under both the
+    tuned shape class and ``"*"``.
+
+    ``measure(tag, thunk, repeats) -> seconds`` is injectable for
+    deterministic tests; the default times real fenced dispatches.
+    """
+    if budget not in BUDGETS:
+        raise ValueError(f"budget must be one of {sorted(BUDGETS)}; got {budget!r}")
+    cfg = BUDGETS[budget]
+    groups = tuple(groups or _GROUPS)
+    bad = [g for g in groups if g not in _GROUPS]
+    if bad:
+        raise ValueError(f"unknown search groups: {bad}")
+    real = measure is None
+    measure = measure or _measure_real
+    repeats = cfg["repeats"]
+
+    import jax
+
+    from spark_ensemble_tpu import DecisionTreeRegressor, GBMClassifier
+
+    platform, device_kind = _device_identity()
+    n, d, k = cfg["n"], cfg["d"], cfg["k"]
+    X, y = _synth_classification(n, d, k)
+    sc = shape_class(n)
+
+    winners: Dict[str, Any] = {}
+    timings: Dict[str, Dict[str, float]] = {}
+
+    def fresh_estimator(**extra):
+        return GBMClassifier(
+            num_base_learners=cfg["rounds"],
+            loss="logloss",
+            updates="newton",
+            learning_rate=0.3,
+            base_learner=DecisionTreeRegressor(
+                max_depth=cfg["depth"], max_bins=cfg["bins"]
+            ),
+            **extra,
+        )
+
+    # -- fit group: hist tier, then scan_chunk at the winning tier ----------
+    model = None
+    if "fit" in groups:
+        def fit_thunk():
+            est = fresh_estimator()
+
+            def run():
+                return est.fit(X, y).params
+
+            return run
+
+        tiers = ["auto", "scatter", "matmul"]
+        if n > 200_000:
+            tiers.append("stream")  # stream only wins at HBM scale
+        tier, _, _ = _sweep(
+            "hist_tier", tiers, fit_thunk, measure, repeats, timings,
+            real=real,
+        )
+        if tier != "auto":
+            winners["hist_tier"] = tier
+        tier_ov = {"hist_tier": tier} if tier != "auto" else {}
+
+        chunks = _candidate_rows(
+            [c for c in TUNABLES["scan_chunk"].candidates
+             if c <= cfg["rounds"] * 2],
+            TUNABLES["scan_chunk"].default,
+        )
+        chunk, _, _ = _sweep(
+            "scan_chunk", chunks, fit_thunk, measure, repeats, timings,
+            extra_override=tier_ov, real=real,
+        )
+        if chunk != TUNABLES["scan_chunk"].default:
+            winners["scan_chunk"] = chunk
+        if real:
+            with override(mode="cache", **{**tier_ov,
+                                           "scan_chunk": chunk}):
+                clear_program_caches()
+                model = fresh_estimator().fit(X, y)
+
+    # -- predict group: the fused-predict cell budget -----------------------
+    if "predict" in groups:
+        if model is None and real:
+            model = fresh_estimator().fit(X, y)
+        Xd = jax.numpy.asarray(X)
+
+        def predict_thunk():
+            if not real:
+                return lambda: None
+            m = model
+
+            def run():
+                return m.predict(Xd)
+
+            return run
+
+        cells = _candidate_rows(
+            list(TUNABLES["predict_fused_max_cells"].candidates),
+            TUNABLES["predict_fused_max_cells"].default,
+        )
+        cell, _, _ = _sweep(
+            "predict_fused_max_cells", cells, predict_thunk, measure,
+            max(repeats * 3, 3), timings, real=real,
+        )
+        if cell != TUNABLES["predict_fused_max_cells"].default:
+            winners["predict_fused_max_cells"] = cell
+
+    # -- stream group: rows per scan step of the stream hist tier -----------
+    if "stream" in groups:
+        def stream_thunk():
+            if not real:
+                return lambda: None
+            from spark_ensemble_tpu.models.tree import DecisionTreeRegressor as DT
+
+            est = DT(
+                max_depth=cfg["depth"], max_bins=cfg["bins"], hist="stream"
+            )
+            yr = (np.asarray(y, np.float32) - float(np.mean(y)))
+
+            def run():
+                return est.fit(X, yr).params
+
+            return run
+
+        rows = _candidate_rows(
+            [c for c in TUNABLES["stream_chunk_rows"].candidates if c <= 4 * n],
+            TUNABLES["stream_chunk_rows"].default,
+        )
+        row, _, _ = _sweep(
+            "stream_chunk_rows", rows, stream_thunk, measure, repeats,
+            timings, real=real,
+        )
+        if row != TUNABLES["stream_chunk_rows"].default:
+            winners["stream_chunk_rows"] = row
+
+    # -- bucket group: the predict bucket ladder over mixed request sizes --
+    if "bucket" in groups:
+        if model is None and real:
+            model = fresh_estimator().fit(X, y)
+        rng = np.random.default_rng(1)
+        sizes = [int(s) for s in rng.integers(1, max(n // 4, 2), size=24)]
+        reqs = [X[:s] for s in sizes]
+
+        def bucket_thunk():
+            if not real:
+                return lambda: None
+            m = model
+
+            def run():
+                out = None
+                for r in reqs:
+                    out = m.predict(r)
+                return out
+
+            return run
+
+        for name in ("predict_bucket_pow2_exact",
+                     "predict_bucket_octave_steps"):
+            cands = _candidate_rows(
+                list(TUNABLES[name].candidates), TUNABLES[name].default
+            )
+            won, _, _ = _sweep(
+                name, cands, bucket_thunk, measure, repeats, timings,
+                real=real,
+            )
+            if won != TUNABLES[name].default:
+                winners[name] = won
+
+    # -- pallas group: kernel tiling (TPU only — interpret mode timings
+    # are meaningless) ------------------------------------------------------
+    if "pallas" in groups:
+        if platform == "tpu" or not real:
+            def pallas_thunk():
+                if not real:
+                    return lambda: None
+                from spark_ensemble_tpu.ops.pallas_hist import hist_level_pallas
+
+                rng = np.random.default_rng(2)
+                Xb = jax.numpy.asarray(
+                    rng.integers(0, cfg["bins"], size=(n, d), dtype=np.int32)
+                )
+                node = jax.numpy.asarray(
+                    rng.integers(0, 8, size=(n, 4), dtype=np.int32)
+                )
+                vals = jax.numpy.asarray(
+                    rng.standard_normal((n, 4, 3)).astype(np.float32)
+                )
+
+                def run():
+                    return hist_level_pallas(
+                        Xb, node, vals, n_nodes=8, max_bins=cfg["bins"]
+                    )
+
+                return run
+
+            cands = _candidate_rows(
+                list(TUNABLES["pallas_block_rows"].candidates),
+                TUNABLES["pallas_block_rows"].default,
+            )
+            br, _, _ = _sweep(
+                "pallas_block_rows", cands, pallas_thunk, measure,
+                repeats, timings, real=real,
+            )
+            if br != TUNABLES["pallas_block_rows"].default:
+                winners["pallas_block_rows"] = br
+        else:
+            logger.info("pallas group skipped: platform=%s (TPU only)", platform)
+
+    result = {
+        "winners": winners,
+        "timings": timings,
+        "platform": platform,
+        "device_kind": device_kind,
+        "shape_class": sc,
+        "budget": budget,
+        "shape": {"n": n, "d": d, "k": k, "rounds": cfg["rounds"]},
+    }
+    if save:
+        cache = TuningCache.load(directory)
+        meta = {
+            "budget": budget,
+            "shape": result["shape"],
+            "cache_format": "autotune.search",
+        }
+        cache.put(platform, device_kind, sc, winners, meta)
+        cache.put(platform, device_kind, "*", winners, meta)
+        result["cache_path"] = cache.save(directory)
+        reset()  # published generation supersedes the memoized view
+    if real:
+        clear_program_caches()
+    return result
+
+
+def autotune_fit(
+    estimator,
+    X,
+    y=None,
+    *,
+    budget: str = "smoke",
+    measure: Optional[Callable] = None,
+    save: bool = True,
+    directory: Optional[str] = None,
+    force: bool = False,
+) -> Dict[str, Any]:
+    """In-process fast path: make sure tuned winners exist for THIS
+    device and this dataset's shape class, searching only on a miss.
+
+    A cache hit short-circuits the search entirely (zero measurements) —
+    call with ``force=True`` to re-measure.  Returns the ``run_search``
+    result dict, or ``{"cached": True, "params": {...}}`` on a hit.
+    The estimator's own hand-set params are never overridden: resolution
+    consults the cache only for params the user left at their defaults.
+    """
+    platform, device_kind = _device_identity()
+    n = int(np.shape(X)[0])
+    sc = shape_class(n)
+    cache = TuningCache.load(directory)
+    if not force:
+        params = cache.lookup(platform, device_kind, sc)
+        if params:
+            return {
+                "cached": True,
+                "params": params,
+                "platform": platform,
+                "device_kind": device_kind,
+                "shape_class": sc,
+            }
+    # size the search budget off the actual data when smaller than the
+    # budget's nominal shape (tuning must stay cheap next to the fit)
+    cfg = dict(BUDGETS[budget])
+    cfg["n"] = min(cfg["n"], max(n, 256))
+    saved = BUDGETS[budget]
+    BUDGETS[budget] = cfg
+    try:
+        return run_search(
+            budget, measure=measure, save=save, directory=directory
+        )
+    finally:
+        BUDGETS[budget] = saved

@@ -1,0 +1,150 @@
+"""The typed tunable space: every hand-guessed hot-path constant, named.
+
+Three rounds of roofline work left performance-critical launch/tiling
+parameters as hand-guessed literals — ``scan_chunk`` (models/gbm.py),
+``_STREAM_CHUNK_ROWS`` / ``_PREDICT_FUSED_MAX_CELLS`` (ops/tree.py),
+``_BLOCK_ROWS`` / ``_VMEM_BUDGET`` (ops/pallas_hist.py), the predict
+bucket ladder (models/base.py) and the dense/stream/scatter histogram
+tier itself.  GPU GBDT systems win precisely by tuning these to the
+device (XGBoost GPU, arXiv:1806.11248); this module gives each knob a
+name, its shipped default, the candidate grid a measured search sweeps,
+and the source site the value feeds — so the search (autotune.search),
+the on-disk cache (autotune.cache) and the resolution layer
+(autotune.resolve) all speak one schema.
+
+Defaults here MUST mirror the literals at the source sites: when
+autotuning is off (``SE_TPU_AUTOTUNE=off``) or no cache entry exists,
+``resolve`` returns the caller's live module constant and behavior is
+bit-identical to a build without this package.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, Iterator, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Tunable:
+    """One measured knob: name, shipped default, candidate grid, site."""
+
+    name: str
+    default: object
+    candidates: Tuple[object, ...]
+    doc: str
+    site: str  # "<module>:<constant or param>" the value feeds
+    kind: str = "int"  # "int" | "choice"
+
+    def validate(self, value) -> bool:
+        if self.kind == "choice":
+            return value in self.candidates
+        return isinstance(value, int) and not isinstance(value, bool) and value > 0
+
+
+class TunableSpace:
+    """Ordered, name-addressable collection of :class:`Tunable`."""
+
+    def __init__(self, tunables: Tuple[Tunable, ...]):
+        self._by_name: Dict[str, Tunable] = {}
+        for t in tunables:
+            if t.name in self._by_name:
+                raise ValueError(f"duplicate tunable {t.name!r}")
+            self._by_name[t.name] = t
+
+    def __getitem__(self, name: str) -> Tunable:
+        return self._by_name[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._by_name
+
+    def __iter__(self) -> Iterator[Tunable]:
+        return iter(self._by_name.values())
+
+    def __len__(self) -> int:
+        return len(self._by_name)
+
+    def names(self) -> Tuple[str, ...]:
+        return tuple(self._by_name)
+
+    def defaults(self) -> Dict[str, object]:
+        return {t.name: t.default for t in self}
+
+    def validate_params(self, params: Dict[str, object]) -> Dict[str, object]:
+        """Drop unknown names and type-invalid values (a forward-compat
+        cache written by a newer build must degrade to defaults, not
+        crash the hot path)."""
+        out = {}
+        for name, value in params.items():
+            t = self._by_name.get(name)
+            if t is not None and t.validate(value):
+                out[name] = value
+        return out
+
+
+# the space: defaults == the literals at each site (bit-identity contract)
+TUNABLES = TunableSpace((
+    Tunable(
+        "scan_chunk", 16, (4, 8, 16, 32, 64, 128),
+        doc="boosting rounds fused into one lax.scan-ed dispatch "
+        "(hand-set estimator param always wins)",
+        site="models/gbm.py:scan_chunk",
+    ),
+    Tunable(
+        "stream_chunk_rows", 32768,
+        (8192, 16384, 32768, 65536, 131072),
+        doc="rows per scan step of the STREAM histogram tier",
+        site="ops/tree.py:_STREAM_CHUNK_ROWS",
+    ),
+    Tunable(
+        "predict_fused_max_cells", 2**27,
+        (2**24, 2**25, 2**26, 2**27, 2**28, 2**29, 2**30),
+        doc="rows*members*leaves budget of the fused predict routing "
+        "one-hot; past it predict lax.maps over row chunks",
+        site="ops/tree.py:_PREDICT_FUSED_MAX_CELLS",
+    ),
+    Tunable(
+        "hist_tier", "auto", ("auto", "scatter", "matmul", "stream"),
+        doc="histogram accumulation backend consulted when the "
+        "estimator's hist param is 'auto' (scatter=segment_sum, "
+        "matmul=dense one-hot MXU path, stream=row-chunked)",
+        site="ops/tree.py:_resolve_hist",
+        kind="choice",
+    ),
+    Tunable(
+        "pallas_block_rows", 256, (128, 256, 512, 1024),
+        doc="rows per grid step of the pallas level-histogram kernel",
+        site="ops/pallas_hist.py:_BLOCK_ROWS",
+    ),
+    Tunable(
+        "pallas_vmem_budget", 12 * 2**20,
+        (8 * 2**20, 12 * 2**20, 16 * 2**20, 24 * 2**20),
+        doc="VMEM budget (bytes) for the pallas kernel's resident "
+        "accumulator; configs over it fall back to the matmul path",
+        site="ops/pallas_hist.py:_VMEM_BUDGET",
+    ),
+    Tunable(
+        "predict_bucket_pow2_exact", 512, (256, 512, 1024, 2048),
+        doc="predict batches at or below this pad to the next power of "
+        "two exactly (one trace per pow2 bucket)",
+        site="models/base.py:_BUCKET_POW2_EXACT",
+    ),
+    Tunable(
+        "predict_bucket_octave_steps", 8, (4, 8, 16),
+        doc="buckets per octave above the exact-pow2 range (8 == "
+        "<=12.5% padding; more buckets = less padding, more traces)",
+        site="models/base.py:_BUCKET_OCTAVE_STEPS",
+    ),
+))
+
+
+def shape_class(n: Optional[int] = None) -> str:
+    """Coarse workload key for the config cache: the log2 bucket of the
+    row count (``"n14"`` for letter-scale ~16k rows), or ``"*"`` when no
+    row count is known at the resolution site (e.g. the predict bucket
+    ladder, which serves arbitrary request sizes).  Search results are
+    stored under both the tuned shape's class and ``"*"``; lookup tries
+    the exact class first."""
+    if n is None or n <= 0:
+        return "*"
+    return f"n{round(math.log2(max(int(n), 1)))}"

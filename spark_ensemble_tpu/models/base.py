@@ -98,7 +98,16 @@ def cached_program(key: tuple, build: Callable[[], Callable]) -> Callable:
     ``predict_forest``), so a process that switches backends between fits
     must not reuse a program traced for the other backend.
     """
-    key = key + (jax.default_backend(),)
+    from spark_ensemble_tpu import autotune
+
+    # persistent compilation cache (SE_TPU_COMPILE_CACHE): every program
+    # build funnels through here, so enabling it once at the chokepoint
+    # covers fit, predict, and serving warmup alike
+    autotune.ensure_compilation_cache()
+    # tuning-state fingerprint: trace-time tunables (hist tier, stream
+    # chunk, fused-cell budgets) are latched into programs, so programs
+    # traced under different tuned configs must never share a key
+    key = key + (jax.default_backend(),) + autotune.fingerprint()
     with _PROGRAM_CACHE_LOCK:
         fn = _PROGRAM_CACHE.get(key)
         if fn is not None:
@@ -144,14 +153,21 @@ def predict_buckets_enabled() -> bool:
 def bucket_rows(n: int) -> int:
     """Padded row count for a predict batch of ``n`` rows: the next power
     of two for small batches, then steps of 1/8 of the power of two BELOW
-    ``n`` — padding stays <= 12.5% of ``n`` with 8 buckets per octave."""
+    ``n`` — padding stays <= 12.5% of ``n`` with 8 buckets per octave.
+    Both ladder knobs resolve through autotune (the module constants are
+    the live defaults; measured winners override them per device)."""
+    from spark_ensemble_tpu.autotune import resolve as _tuned
+
     n = int(n)
     if n <= 1:
         return 1
     pow2 = 1 << (n - 1).bit_length()
-    if pow2 <= _BUCKET_POW2_EXACT:
+    if pow2 <= int(_tuned("predict_bucket_pow2_exact", _BUCKET_POW2_EXACT)):
         return pow2
-    step = (pow2 // 2) // _BUCKET_OCTAVE_STEPS
+    octave = int(
+        _tuned("predict_bucket_octave_steps", _BUCKET_OCTAVE_STEPS)
+    )
+    step = max((pow2 // 2) // max(octave, 1), 1)
     return ((n + step - 1) // step) * step
 
 
@@ -232,6 +248,19 @@ def member_leaves(base) -> int:
 
     depth = int(getattr(base, "max_depth", 0) or 0)
     return 2 ** min(depth, _MATMUL_PREDICT_MAX_DEPTH)
+
+
+def resolved_scan_chunk(est, n_rows=None) -> int:
+    """The round-loop chunk length for an iterative estimator: the
+    hand-set ``scan_chunk`` param always wins; when the user left it at
+    the default, a measured winner for this device/shape class
+    (autotune: "scan_chunk") overrides the default literal."""
+    chunk = max(int(est.scan_chunk), 1)
+    if "scan_chunk" in est._param_values:
+        return chunk
+    from spark_ensemble_tpu.autotune import resolve as _tuned
+
+    return max(int(_tuned("scan_chunk", chunk, n=n_rows)), 1)
 
 
 class Model(Params):

@@ -64,6 +64,7 @@ from spark_ensemble_tpu.models.base import (
     cached_program,
     infer_num_classes,
     resolve_weights,
+    resolved_scan_chunk,
 )
 from spark_ensemble_tpu.models.gbm import (
     concat_pytrees,
@@ -210,7 +211,7 @@ class _BoostingParams(CheckpointableParams, Estimator):
             return params_c, est_ws, sum_bws, bw_out, extras
 
         i = start_i
-        chunk = max(int(self.scan_chunk), 1)
+        chunk = resolved_scan_chunk(self, int(bw.shape[0]))
         # a checkpoint resume starts at the full chunk: start_i kept rounds
         # already outweigh the worst-case discard of one fixed-size chunk
         probe = ramp and self.ramp == "auto" and start_i == 0

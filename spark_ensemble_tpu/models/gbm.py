@@ -73,6 +73,7 @@ from spark_ensemble_tpu.models.base import (
     member_leaves,
     mesh_fit_kwargs,
     resolve_weights,
+    resolved_scan_chunk,
 )
 from spark_ensemble_tpu.ops.tree import predict_chunked_rows
 from spark_ensemble_tpu.models.dummy import DummyClassifier, DummyRegressor
@@ -298,6 +299,7 @@ class _GBMParams(CheckpointableParams, Estimator):
         guard=None,  # NumericGuard | None
         snapshot=None,  # () -> opaque copy of the carried prediction state
         restore=None,  # (snap) -> None; rewind the carry to chunk start
+        n_rows: Optional[int] = None,  # training rows (autotune shape class)
     ):
         """The shared round-loop driver: scan-chunked dispatch (one program
         per `scan_chunk` rounds, single-chip AND under a mesh — validation
@@ -317,7 +319,7 @@ class _GBMParams(CheckpointableParams, Estimator):
         from spark_ensemble_tpu.robustness.chaos import controller
         from spark_ensemble_tpu.robustness.retry import retry_call
 
-        chunk = max(int(self.scan_chunk), 1)
+        chunk = resolved_scan_chunk(self, n_rows)
         retry_policy = self._retry_policy()
         ctl = controller()
         guard_on = guard is not None and guard.active
@@ -1105,7 +1107,7 @@ class GBMRegressor(_GBMParams):
             run_chunk, save_state, "GBMRegressor", i, v, best,
             val_history=val_history, telem=telem,
             guard=self._numeric_guard(telem),
-            snapshot=snapshot, restore=restore,
+            snapshot=snapshot, restore=restore, n_rows=n,
         )
         ckpt.delete()
 
@@ -1704,7 +1706,7 @@ class GBMClassifier(_GBMParams):
             run_chunk, save_state, "GBMClassifier", i, v, best,
             val_history=val_history, telem=telem,
             guard=self._numeric_guard(telem),
-            snapshot=snapshot, restore=restore,
+            snapshot=snapshot, restore=restore, n_rows=n,
         )
         ckpt.delete()
 

@@ -35,14 +35,31 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from spark_ensemble_tpu.autotune.resolve import resolve as _tuned
+
 # rows per grid step: bounds VMEM (block one-hots + hi/lo operands) while
-# keeping the MXU contraction dimension >= 2 tiles
+# keeping the MXU contraction dimension >= 2 tiles.  The literal is the
+# DEFAULT; a measured winner (autotune: "pallas_block_rows") overrides it
+# through block_rows() at trace time
 _BLOCK_ROWS = 256
 
 # VMEM budget for the resident accumulator + per-block operands (bytes);
 # configs over this fall back to the XLA matmul path (decided at trace
-# time from static shapes in ops/tree.py)
+# time from static shapes in ops/tree.py).  Tuned via vmem_budget()
+# (autotune: "pallas_vmem_budget")
 _VMEM_BUDGET = 12 * 2**20
+
+
+def block_rows() -> int:
+    """Rows per grid step: the tuned winner for this device, defaulting
+    to the live module constant (so tests monkeypatching ``_BLOCK_ROWS``
+    keep working)."""
+    return int(_tuned("pallas_block_rows", _BLOCK_ROWS))
+
+
+def vmem_budget() -> int:
+    """Kernel VMEM budget in bytes (tuned, live-default like above)."""
+    return int(_tuned("pallas_vmem_budget", _VMEM_BUDGET))
 
 
 # off-TPU, fit_forest only dispatches the interpreted kernel below this
@@ -60,11 +77,15 @@ def _interpret() -> bool:
         return True
 
 
-def hist_vmem_bytes(n_nodes: int, M: int, C: int, d: int, B: int) -> int:
-    """Static VMEM estimate for the accumulator + block operands."""
+def hist_vmem_bytes(
+    n_nodes: int, M: int, C: int, d: int, B: int, blk: int = 0
+) -> int:
+    """Static VMEM estimate for the accumulator + block operands;
+    ``blk`` defaults to the resolved grid-step row count."""
+    blk = blk or block_rows()
     acc = M * n_nodes * C * d * B * 4
-    rhs = _BLOCK_ROWS * d * B * 2
-    lhs = _BLOCK_ROWS * M * n_nodes * C * (4 + 2 + 2)
+    rhs = blk * d * B * 2
+    lhs = blk * M * n_nodes * C * (4 + 2 + 2)
     return acc + rhs + lhs
 
 
@@ -110,34 +131,48 @@ def _hist_kernel(xb_ref, node_ref, vals_ref, out_ref, *, n_nodes, B):
     out_ref[:] += acc
 
 
-@functools.partial(jax.jit, static_argnames=("n_nodes", "max_bins"))
 def hist_level_pallas(Xb, node, vals, *, n_nodes: int, max_bins: int):
     """Level histogram ``H f32[M, n_nodes, C, d, B]`` for all members.
 
     ``Xb i32[n, d]`` shared binned features; ``node i32[n, M]`` each row's
     node at this level per member; ``vals f32[n, M, C]`` the statistic
     channels (w, w*y...).  Zero-weight (padding) rows contribute exactly 0.
+
+    The grid-step row count resolves through ``block_rows()`` here — at
+    trace time, outside the jit below — and enters the compiled program
+    as a static arg, so a tuned value produces a distinct trace instead
+    of silently reusing a program tiled for the old block size.
     """
+    return _hist_level_pallas(
+        Xb, node, vals, n_nodes=n_nodes, max_bins=max_bins,
+        blk=block_rows(),
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_nodes", "max_bins", "blk")
+)
+def _hist_level_pallas(Xb, node, vals, *, n_nodes, max_bins, blk):
     n, d = Xb.shape
     _, M, C = vals.shape
     B = max_bins
 
-    pad = (-n) % _BLOCK_ROWS
+    pad = (-n) % blk
     if pad:
         # padded rows: vals 0 -> zero contribution regardless of node/bin
         Xb = jnp.pad(Xb, ((0, pad), (0, 0)))
         node = jnp.pad(node, ((0, pad), (0, 0)))
         vals = jnp.pad(vals, ((0, pad), (0, 0), (0, 0)))
-    steps = (n + pad) // _BLOCK_ROWS
+    steps = (n + pad) // blk
 
     kernel = functools.partial(_hist_kernel, n_nodes=n_nodes, B=B)
     out = pl.pallas_call(
         kernel,
         grid=(steps,),
         in_specs=[
-            pl.BlockSpec((_BLOCK_ROWS, d), lambda i: (i, 0)),
-            pl.BlockSpec((_BLOCK_ROWS, M), lambda i: (i, 0)),
-            pl.BlockSpec((_BLOCK_ROWS, M, C), lambda i: (i, 0, 0)),
+            pl.BlockSpec((blk, d), lambda i: (i, 0)),
+            pl.BlockSpec((blk, M), lambda i: (i, 0)),
+            pl.BlockSpec((blk, M, C), lambda i: (i, 0, 0)),
         ],
         # constant index map: the accumulator stays VMEM-resident and is
         # revisited (+=) by every sequential grid step

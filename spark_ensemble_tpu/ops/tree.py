@@ -48,6 +48,7 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from spark_ensemble_tpu.autotune.resolve import resolve as _tuned
 from spark_ensemble_tpu.ops.collective import (
     preduce as _preduce,
     pvary_like_shard as _pvary_like_shard,
@@ -254,6 +255,12 @@ def _stat_precision_vs_onehot(stat_prec):
 def _resolve_hist(hist: str, n: int, d: int, B: int) -> str:
     if hist != "auto":
         return hist
+    # a measured winner for this device/shape class overrides the static
+    # heuristic below (autotune.resolve; "auto" == no winner recorded).
+    # An explicit hist param never reaches this branch — hand-set wins.
+    tier = _tuned("hist_tier", "auto", n=n)
+    if tier in ("scatter", "matmul", "stream"):
+        return tier
     # every accelerator backend (tpu, tpu-like plugins, gpu) serializes
     # scatter-adds; only CPU prefers the segment_sum path.  Past the
     # matmul tier's one-hot budget an accelerator takes the row-chunked
@@ -615,7 +622,10 @@ def predict_chunked_rows(fn, Xq, n_members, leaves):
     fits.  Member-leading outputs: transpose around the call (cheap — XLA
     layout assignment)."""
     n = Xq.shape[0]
-    chunk = max(1024, _PREDICT_FUSED_MAX_CELLS // max(n_members * leaves, 1))
+    # the module constant is the live default (tests monkeypatch it); a
+    # measured winner for this device/shape class overrides it
+    cells = _tuned("predict_fused_max_cells", _PREDICT_FUSED_MAX_CELLS, n=n)
+    chunk = max(1024, cells // max(n_members * leaves, 1))
     if n <= chunk:
         return fn(Xq)
     nc = -(-n // chunk)
@@ -667,7 +677,7 @@ def _fit_forest_streamed(
         [w[:, :, None], w[:, :, None] * (Y - y_mean[None, :, :])], axis=2
     )  # [n, M, 1+k]
 
-    chunk = min(_STREAM_CHUNK_ROWS, n)
+    chunk = min(_tuned("stream_chunk_rows", _STREAM_CHUNK_ROWS, n=n), n)
     nc = -(-n // chunk)
     pad = nc * chunk - n
     # the scan re-reads the binned features once per level: store them at
@@ -846,9 +856,9 @@ def fit_forest(
     if pallas_tier:
         from spark_ensemble_tpu.ops.pallas_hist import (
             _INTERPRET_MAX_ROWS,
-            _VMEM_BUDGET,
             _interpret,
             hist_vmem_bytes,
+            vmem_budget,
         )
 
         if _interpret() and n > _INTERPRET_MAX_ROWS:
@@ -867,7 +877,7 @@ def fit_forest(
             pallas_tier = False
         elif (
             hist_vmem_bytes(2 ** (max_depth - 1), M, 1 + k, d, B)
-            > _VMEM_BUDGET
+            > vmem_budget()
         ):
             pallas_tier = False
     # case-normalized here (not at the Param) so direct kernel callers get

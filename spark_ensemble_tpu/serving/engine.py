@@ -207,6 +207,9 @@ class InferenceEngine:
         """AOT-compile every (method, bucket) program and execute each once
         on zeros (touches allocator paths), then snapshot the compile
         counters — ``stats()['compiles_since_warmup']`` counts from here."""
+        from spark_ensemble_tpu.autotune import ensure_compilation_cache
+
+        ensure_compilation_cache()
         d = self._packed.num_features
         for method in methods or self._methods:
             for b in self._buckets:

@@ -86,9 +86,16 @@ def global_metrics() -> MetricsRegistry:
 _COMPILE_LOCK = threading.Lock()
 _COMPILE_COUNT = 0
 _COMPILE_SECS = 0.0
+_CACHE_REQUESTS = 0
+_CACHE_HITS = 0
 _LISTENER_STATE = {"registered": False}
 
 _BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+# NOTE: _BACKEND_COMPILE_EVENT wraps compile_or_get_cached in current jax,
+# so it fires even when the persistent compilation cache serves the
+# executable from disk.  Real compile work is requests - hits below.
+_CACHE_REQUEST_EVENT = "/jax/compilation_cache/compile_requests_use_cache"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
 
 
 def _on_event_duration(event: str, duration: float, **_kw) -> None:
@@ -99,6 +106,18 @@ def _on_event_duration(event: str, duration: float, **_kw) -> None:
             _COMPILE_SECS += float(duration)
         _GLOBAL.counter("jit/compile_count").inc()
         _GLOBAL.histogram("jit/compile_seconds").record(float(duration))
+
+
+def _on_event(event: str, **_kw) -> None:
+    global _CACHE_REQUESTS, _CACHE_HITS
+    if event == _CACHE_REQUEST_EVENT:
+        with _COMPILE_LOCK:
+            _CACHE_REQUESTS += 1
+        _GLOBAL.counter("jit/persistent_cache_requests").inc()
+    elif event == _CACHE_HIT_EVENT:
+        with _COMPILE_LOCK:
+            _CACHE_HITS += 1
+        _GLOBAL.counter("jit/persistent_cache_hits").inc()
 
 
 def _ensure_compile_listener() -> None:
@@ -112,6 +131,7 @@ def _ensure_compile_listener() -> None:
         from jax import monitoring
 
         monitoring.register_event_duration_secs_listener(_on_event_duration)
+        monitoring.register_event_listener(_on_event)
     except Exception:  # pragma: no cover - jax without monitoring
         pass
 
@@ -120,6 +140,15 @@ def compile_snapshot() -> tuple:
     """(count, seconds) of backend compiles observed so far this process."""
     with _COMPILE_LOCK:
         return _COMPILE_COUNT, _COMPILE_SECS
+
+
+def persistent_cache_snapshot() -> tuple:
+    """(requests, hits) of persistent-compilation-cache lookups so far;
+    ``requests - hits`` is the number of REAL backend compiles when the
+    cache is active (the compile-duration event above cannot tell a disk
+    hit from a compile)."""
+    with _COMPILE_LOCK:
+        return _CACHE_REQUESTS, _CACHE_HITS
 
 
 def device_memory_stats() -> Dict[str, Dict[str, int]]:

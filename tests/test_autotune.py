@@ -1,0 +1,464 @@
+"""Autotuned execution engine (`spark_ensemble_tpu/autotune/`,
+docs/autotune.md): the typed tunable space mirrors the live source
+literals, the on-disk cache round-trips with checkpoint-grade crash
+consistency, the measured search picks deterministic winners, resolution
+order (override > off > cache > default) holds at every site, and
+``SE_TPU_AUTOTUNE=off`` keeps fits bit-identical to an untuned build."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import spark_ensemble_tpu as se
+from spark_ensemble_tpu.autotune import (
+    TUNABLES,
+    TuningCache,
+    autotune_fit,
+    fingerprint,
+    override,
+    reset,
+    resolve,
+    resolved_snapshot,
+    run_search,
+    shape_class,
+)
+from spark_ensemble_tpu.autotune.cache import entry_key, manifest_signature
+from spark_ensemble_tpu.autotune.resolve import _device_identity
+
+
+@pytest.fixture(autouse=True)
+def _isolated_cache(tmp_path, monkeypatch):
+    """Every test gets an empty cache dir and the default mode; the
+    memoized cache view is dropped on both sides."""
+    monkeypatch.setenv("SE_TPU_AUTOTUNE_CACHE", str(tmp_path / "atc"))
+    monkeypatch.delenv("SE_TPU_AUTOTUNE", raising=False)
+    reset()
+    yield
+    reset()
+
+
+def _fake_measure(times):
+    """measure(tag, thunk, repeats) stub returning scripted times and
+    recording every call."""
+    calls = []
+
+    def measure(tag, thunk, repeats):
+        calls.append(tag)
+        key = (tag["tunable"], tag["candidate"])
+        return times.get(key, times.get(tag["tunable"], 1.0))
+
+    measure.calls = calls
+    return measure
+
+
+# ---------------------------------------------------------------------------
+# space
+# ---------------------------------------------------------------------------
+
+
+def test_defaults_mirror_source_literals():
+    """The bit-identity contract: every tunable's default equals the live
+    literal at its source site."""
+    import spark_ensemble_tpu.models.base as mb
+    import spark_ensemble_tpu.ops.pallas_hist as ph
+    import spark_ensemble_tpu.ops.tree as T
+    from spark_ensemble_tpu.models.gbm import GBMRegressor
+
+    d = TUNABLES.defaults()
+    assert d["scan_chunk"] == GBMRegressor().scan_chunk
+    assert d["stream_chunk_rows"] == T._STREAM_CHUNK_ROWS
+    assert d["predict_fused_max_cells"] == T._PREDICT_FUSED_MAX_CELLS
+    assert d["pallas_block_rows"] == ph._BLOCK_ROWS
+    assert d["pallas_vmem_budget"] == ph._VMEM_BUDGET
+    assert d["predict_bucket_pow2_exact"] == mb._BUCKET_POW2_EXACT
+    assert d["predict_bucket_octave_steps"] == mb._BUCKET_OCTAVE_STEPS
+    assert d["hist_tier"] == "auto"
+
+
+def test_validate_params_drops_unknown_and_invalid():
+    got = TUNABLES.validate_params({
+        "scan_chunk": 32,              # valid
+        "hist_tier": "matmul",         # valid choice
+        "stream_chunk_rows": -4,       # invalid: not positive
+        "pallas_block_rows": "256",    # invalid: wrong type
+        "scan_chunk_v2": 64,           # unknown name (future cache)
+        "predict_bucket_octave_steps": True,  # bool is not an int here
+    })
+    assert got == {"scan_chunk": 32, "hist_tier": "matmul"}
+
+
+def test_shape_class_buckets():
+    assert shape_class(None) == "*"
+    assert shape_class(0) == "*"
+    assert shape_class(15000) == "n14"  # letter scale
+    assert shape_class(16384) == "n14"
+    assert shape_class(1) == "n0"
+
+
+# ---------------------------------------------------------------------------
+# cache
+# ---------------------------------------------------------------------------
+
+
+def test_cache_round_trip(tmp_path):
+    d = str(tmp_path / "c")
+    cache = TuningCache()
+    cache.put("cpu", "cpu", "n14", {"scan_chunk": 64, "hist_tier": "matmul"})
+    cache.put("cpu", "cpu", "*", {"predict_bucket_pow2_exact": 1024})
+    path = cache.save(d)
+    assert os.path.isdir(path)
+    loaded = TuningCache.load(d)
+    # exact class merges over the platform-wide "*" entry
+    assert loaded.lookup("cpu", "cpu", "n14") == {
+        "scan_chunk": 64,
+        "hist_tier": "matmul",
+        "predict_bucket_pow2_exact": 1024,
+    }
+    # unknown shape class still serves the "*" entry
+    assert loaded.lookup("cpu", "cpu", "n9") == {
+        "predict_bucket_pow2_exact": 1024,
+    }
+    # a different device has no entries at all
+    assert loaded.lookup("tpu", "TPU v5e", "n14") == {}
+
+
+def test_cache_save_retains_previous_generation(tmp_path):
+    d = str(tmp_path / "c")
+    first = TuningCache()
+    first.put("cpu", "cpu", "*", {"scan_chunk": 8})
+    first.save(d)
+    second = TuningCache()
+    second.put("cpu", "cpu", "*", {"scan_chunk": 128})
+    second.save(d)
+    assert TuningCache.load(d).lookup("cpu", "cpu", "*") == {"scan_chunk": 128}
+    assert os.path.isdir(os.path.join(d, ".cache-old"))
+
+
+def test_manifest_corruption_falls_back(tmp_path):
+    d = str(tmp_path / "c")
+    cache = TuningCache()
+    cache.put("cpu", "cpu", "*", {"scan_chunk": 64})
+    cache.save(d)
+    # corrupt the published payload without touching the manifest: the
+    # sha256 check must reject it and (no .cache-old yet) load empty
+    tuned = os.path.join(d, "latest", "tuned.json")
+    with open(tuned, "a") as f:
+        f.write(" ")
+    assert TuningCache.load(d).entries == {}
+
+    # now publish a good generation over the corrupt one, then corrupt
+    # the NEW latest: load must fall back to the retained generation
+    good = TuningCache()
+    good.put("cpu", "cpu", "*", {"scan_chunk": 32})
+    good.save(d)
+    newer = TuningCache()
+    newer.put("cpu", "cpu", "*", {"scan_chunk": 128})
+    newer.save(d)
+    with open(os.path.join(d, "latest", "manifest.json"), "w") as f:
+        f.write("{not json")
+    assert TuningCache.load(d).lookup("cpu", "cpu", "*") == {"scan_chunk": 32}
+
+
+def test_cache_version_mismatch_ignored(tmp_path):
+    d = str(tmp_path / "c")
+    cache = TuningCache()
+    cache.put("cpu", "cpu", "*", {"scan_chunk": 64})
+    cache.save(d)
+    man_path = os.path.join(d, "latest", "manifest.json")
+    with open(man_path) as f:
+        man = json.load(f)
+    man["version"] = 999
+    with open(man_path, "w") as f:
+        json.dump(man, f)
+    assert TuningCache.load(d).entries == {}
+
+
+def test_entry_key_normalizes_slashes():
+    assert entry_key("tpu", "TPU v5 lite", "n14") == "tpu/TPU v5 lite/n14"
+    assert entry_key("tpu", "odd/kind", "n14") == "tpu/odd_kind/n14"
+
+
+# ---------------------------------------------------------------------------
+# resolve
+# ---------------------------------------------------------------------------
+
+
+def test_resolution_order_cache_then_default():
+    platform, kind = _device_identity()
+    cache = TuningCache()
+    cache.put(platform, kind, "*", {"scan_chunk": 64})
+    cache.save()
+    reset()
+    assert resolve("scan_chunk", 16, n=2048) == 64
+    # a name the cache has no entry for returns the caller's default
+    assert resolve("stream_chunk_rows", 32768, n=2048) == 32768
+
+
+def test_off_mode_ignores_cache(monkeypatch):
+    platform, kind = _device_identity()
+    cache = TuningCache()
+    cache.put(platform, kind, "*", {"scan_chunk": 64})
+    cache.save()
+    reset()
+    monkeypatch.setenv("SE_TPU_AUTOTUNE", "off")
+    assert resolve("scan_chunk", 16, n=2048) == 16
+    assert fingerprint() == ("autotune-off",)
+    snap = resolved_snapshot(2048)
+    assert snap["mode"] == "off" and not snap["cache_hit"]
+
+
+def test_override_wins_over_cache():
+    platform, kind = _device_identity()
+    cache = TuningCache()
+    cache.put(platform, kind, "*", {"scan_chunk": 64})
+    cache.save()
+    reset()
+    with override(scan_chunk=4):
+        assert resolve("scan_chunk", 16, n=2048) == 4
+    assert resolve("scan_chunk", 16, n=2048) == 64
+    with pytest.raises(ValueError):
+        with override(not_a_tunable=1):
+            pass
+
+
+def test_fingerprint_tracks_tuning_state():
+    """Programs traced under different tuning states must get different
+    cached_program keys (trace-time latching)."""
+    base = fingerprint()
+    with override(scan_chunk=4):
+        assert fingerprint() != base
+    platform, kind = _device_identity()
+    cache = TuningCache()
+    cache.put(platform, kind, "*", {"scan_chunk": 64})
+    cache.save()
+    reset()
+    assert fingerprint() != base  # manifest signature changed
+
+
+def test_manifest_signature_changes_on_save():
+    assert manifest_signature() is None
+    cache = TuningCache()
+    cache.put("cpu", "cpu", "*", {"scan_chunk": 64})
+    cache.save()
+    assert manifest_signature() is not None
+
+
+def test_bucket_rows_honors_tuned_ladder():
+    from spark_ensemble_tpu.models.base import bucket_rows
+
+    # defaults: pow2 up to 512, then 1/8-octave steps
+    assert bucket_rows(300) == 512
+    assert bucket_rows(1100) == 1152  # step = 1024/8 = 128
+    with override(predict_bucket_pow2_exact=2048):
+        assert bucket_rows(1100) == 2048  # now inside the exact-pow2 range
+    with override(predict_bucket_octave_steps=4):
+        assert bucket_rows(1100) == 1280  # step = 1024/4 = 256
+
+
+def test_hand_set_scan_chunk_wins():
+    from spark_ensemble_tpu.models.base import resolved_scan_chunk
+
+    tuned = se.GBMRegressor()
+    hand = se.GBMRegressor(scan_chunk=8)
+    with override(scan_chunk=64):
+        assert resolved_scan_chunk(tuned, 2048) == 64
+        assert resolved_scan_chunk(hand, 2048) == 8
+
+
+def test_hand_set_hist_tier_wins(monkeypatch):
+    from spark_ensemble_tpu.ops.tree import _resolve_hist
+
+    with override(hist_tier="stream"):
+        # 'auto' consults the tuned tier ...
+        assert _resolve_hist("auto", n=4096, d=8, B=32) == "stream"
+        # ... but an explicit estimator param short-circuits it
+        assert _resolve_hist("matmul", n=4096, d=8, B=32) == "matmul"
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+
+def test_search_winner_is_deterministic_and_beats_noise_floor():
+    """Scripted timings: a candidate that beats the default by more than
+    the noise floor wins; one inside the floor loses to the default."""
+    measure = _fake_measure({
+        ("scan_chunk", 16): 1.00,
+        ("scan_chunk", 4): 0.90,    # -10%: a real win
+        ("scan_chunk", 8): 0.995,   # -0.5%: noise
+        ("scan_chunk", 32): 1.20,
+        "hist_tier": 1.0,           # flat: default ("auto") must win
+    })
+    res = run_search(
+        budget="smoke", groups=("fit",), measure=measure, save=False
+    )
+    assert res["winners"] == {"scan_chunk": 4}
+    assert "hist_tier" not in res["winners"]
+    # deterministic: the same scripted timings pick the same winner
+    res2 = run_search(
+        budget="smoke", groups=("fit",),
+        measure=_fake_measure({
+            ("scan_chunk", 16): 1.00, ("scan_chunk", 4): 0.90,
+            ("scan_chunk", 8): 0.995, ("scan_chunk", 32): 1.20,
+            "hist_tier": 1.0,
+        }),
+        save=False,
+    )
+    assert res2["winners"] == res["winners"]
+
+
+def test_search_publishes_both_shape_classes(tmp_path):
+    d = str(tmp_path / "pub")
+    measure = _fake_measure({("scan_chunk", 4): 0.5, "scan_chunk": 1.0,
+                             "hist_tier": 1.0})
+    res = run_search(
+        budget="smoke", groups=("fit",), measure=measure, directory=d
+    )
+    assert res["winners"] == {"scan_chunk": 4}
+    loaded = TuningCache.load(d)
+    platform, kind = _device_identity()
+    assert loaded.lookup(platform, kind, res["shape_class"]) == res["winners"]
+    assert loaded.lookup(platform, kind, "nope") == res["winners"]  # via "*"
+
+
+def test_autotune_fit_cache_hit_short_circuits():
+    X = np.zeros((2048, 4), np.float32)
+    platform, kind = _device_identity()
+    cache = TuningCache()
+    cache.put(platform, kind, shape_class(2048), {"scan_chunk": 64})
+    cache.save()
+    reset()
+    measure = _fake_measure({})
+    out = autotune_fit(se.GBMRegressor(), X, budget="smoke", measure=measure)
+    assert out["cached"] is True
+    assert out["params"] == {"scan_chunk": 64}
+    assert measure.calls == []  # zero measurements on a hit
+    # force=True re-measures even with the entry present
+    out2 = autotune_fit(
+        se.GBMRegressor(), X, budget="smoke", measure=measure,
+        save=False, force=True,
+    )
+    assert "cached" not in out2
+    assert len(measure.calls) > 0
+
+
+def test_unknown_budget_and_group_raise():
+    with pytest.raises(ValueError):
+        run_search(budget="huge", measure=_fake_measure({}), save=False)
+    with pytest.raises(ValueError):
+        run_search(
+            budget="smoke", groups=("nope",),
+            measure=_fake_measure({}), save=False,
+        )
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: SE_TPU_AUTOTUNE=off vs unset-with-no-cache
+# ---------------------------------------------------------------------------
+
+
+def test_fit_bit_identical_off_vs_untuned(monkeypatch):
+    """With no cache entries, mode 'cache' resolves every tunable to its
+    default — fits must be BIT-identical to mode 'off'."""
+    import jax
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 6).astype(np.float32)
+    y = (X[:, 0] + 0.3 * rng.randn(300) > 0).astype(np.float32)
+
+    def leaves(mode):
+        if mode is None:
+            monkeypatch.delenv("SE_TPU_AUTOTUNE", raising=False)
+        else:
+            monkeypatch.setenv("SE_TPU_AUTOTUNE", mode)
+        m = se.GBMClassifier(num_base_learners=4, seed=0).fit(X, y)
+        return [np.asarray(v) for v in jax.tree.leaves(m.params)]
+
+    a, b = leaves(None), leaves("off")
+    assert len(a) == len(b)
+    for va, vb in zip(a, b):
+        np.testing.assert_array_equal(va, vb)
+
+
+def test_tuned_entry_changes_resolution_but_model_quality_holds():
+    """A tuned scan_chunk produces the same model (chunking is a pure
+    batching decision) while actually resolving through the cache."""
+    platform, kind = _device_identity()
+    cache = TuningCache()
+    cache.put(platform, kind, "*", {"scan_chunk": 2})
+    cache.save()
+    reset()
+    rng = np.random.RandomState(1)
+    X = rng.randn(300, 6).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    import jax
+
+    m_tuned = se.GBMClassifier(num_base_learners=4, seed=0).fit(X, y)
+    with override(mode="off"):
+        m_off = se.GBMClassifier(num_base_learners=4, seed=0).fit(X, y)
+    for va, vb in zip(
+        jax.tree.leaves(m_tuned.params), jax.tree.leaves(m_off.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(va), np.asarray(vb), rtol=1e-6, atol=1e-6
+        )
+
+
+# ---------------------------------------------------------------------------
+# collective version seam (ops/collective.py pvary/pcast guard)
+# ---------------------------------------------------------------------------
+
+
+def test_pvary_like_shard_handles_all_jax_spellings(monkeypatch):
+    """Pin the pcast/pvary/neither fallback chain: the helper must track
+    jax's rename (pvary -> pcast(to='varying')) without AttributeError on
+    either side of it."""
+    import jax
+
+    from spark_ensemble_tpu.ops.collective import pvary_like_shard
+
+    x = object()
+    assert pvary_like_shard(x, None) is x  # unsharded: identity
+
+    seen = {}
+
+    def fake_pcast(v, names, to):
+        seen["pcast"] = (names, to)
+        return v
+
+    def fake_pvary(v, names):
+        seen["pvary"] = names
+        return v
+
+    monkeypatch.setattr(jax.lax, "pcast", fake_pcast, raising=False)
+    monkeypatch.setattr(jax.lax, "pvary", fake_pvary, raising=False)
+    assert pvary_like_shard(x, "data") is x
+    assert seen == {"pcast": (("data",), "varying")}  # pcast preferred
+
+    seen.clear()
+    monkeypatch.delattr(jax.lax, "pcast", raising=False)
+    assert pvary_like_shard(x, ("data", "model")) is x
+    assert seen == {"pvary": ("data", "model")}  # old spelling
+
+    monkeypatch.delattr(jax.lax, "pvary", raising=False)
+    assert pvary_like_shard(x, "data") is x  # neither: no-op, no raise
+
+
+def test_enable_compilation_cache_unlatches_stale_init(tmp_path, monkeypatch):
+    """jax latches its persistent-cache state at the process's FIRST
+    compile; enabling after an early compile must reset the latch so the
+    next compile re-initializes against the configured directory."""
+    from jax._src import compilation_cache as jcc
+
+    from spark_ensemble_tpu.autotune import compilation_cache as cc_mod
+
+    monkeypatch.setattr(cc_mod, "_ENABLED_DIR", None)
+    # simulate: something compiled before any cache dir was configured
+    monkeypatch.setattr(jcc, "_cache", None)
+    monkeypatch.setattr(jcc, "_cache_initialized", True)
+    assert cc_mod.enable_compilation_cache(str(tmp_path / "cc"))
+    assert jcc._cache_initialized is False  # re-inits on the next compile
+    assert cc_mod.compilation_cache_dir() == str(tmp_path / "cc")

@@ -174,13 +174,15 @@ def test_kernel_lowers_for_tpu(monkeypatch):
         (15000, 16, 26, 2, 16, 64),  # letter headline, deepest level
         (1024, 8, 4, 2, 1, 16),  # level 0
     ):
-        # hist_level_pallas is already jit-wrapped with static_argnames
-        exp = export.export(ph.hist_level_pallas, platforms=("tpu",))(
+        # the inner impl is the jit-wrapped function export needs; the
+        # public wrapper resolves the (tunable) block size at trace time
+        exp = export.export(ph._hist_level_pallas, platforms=("tpu",))(
             jnp.zeros((n, d), jnp.int32),
             jnp.zeros((n, M), jnp.int32),
             jnp.zeros((n, M, C), jnp.float32),
             n_nodes=n_nodes,
             max_bins=B,
+            blk=ph.block_rows(),
         )
         assert "tpu_custom_call" in exp.mlir_module()
     # the monkeypatched interpret=False decision is baked into the jit

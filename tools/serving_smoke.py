@@ -59,8 +59,20 @@ def cmd_export(args):
 
 
 def cmd_serve(args):
+    from spark_ensemble_tpu.autotune import ensure_compilation_cache
     from spark_ensemble_tpu.serving import InferenceEngine, load_packed
+    from spark_ensemble_tpu.telemetry.events import (
+        _ensure_compile_listener,
+        persistent_cache_snapshot,
+    )
 
+    # with SE_TPU_COMPILE_CACHE set, every compile request is served from
+    # the persistent on-disk cache when warm — a second run must observe
+    # ZERO cache misses during warmup (asserted via --max-warmup-compiles
+    # in CI; the backend_compile duration event fires on hits too, so
+    # misses = requests - hits is the real-compile count)
+    ensure_compilation_cache()
+    _ensure_compile_listener()
     expected = np.load(os.path.join(args.out, "expected.npz"))
     X = expected["X"]
     packed = load_packed(os.path.join(args.out, "model"))
@@ -75,12 +87,25 @@ def cmd_serve(args):
     # contract 2: the warmed engine serves allclose results (whole-model
     # fusion can move float rounding ~1 ulp) with ZERO compiles after
     # warmup, sync and through the coalescing queue
+    req0, hit0 = persistent_cache_snapshot()
     engine = InferenceEngine(
         packed,
         methods=("predict", "predict_proba"),
         max_batch_size=256,
         telemetry_path=args.telemetry,
     )
+    req1, hit1 = persistent_cache_snapshot()
+    warmup_compiles = (req1 - req0) - (hit1 - hit0)
+    if args.max_warmup_compiles is not None:
+        assert req1 > req0, (
+            "persistent compilation cache inactive during warmup "
+            "(SE_TPU_COMPILE_CACHE unset or unusable)"
+        )
+        assert warmup_compiles <= args.max_warmup_compiles, (
+            f"warmup ran {warmup_compiles} real backend compiles "
+            f"({req1 - req0} requests, {hit1 - hit0} cache hits), expected "
+            f"<= {args.max_warmup_compiles} (persistent compile cache cold?)"
+        )
     rng = np.random.RandomState(0)
     for n in rng.randint(1, X.shape[0], size=20):
         out = engine.predict(X[:n])
@@ -100,6 +125,7 @@ def cmd_serve(args):
     print(json.dumps({
         "served_bit_identical": True,
         "compiles_since_warmup": stats["compiles_since_warmup"],
+        "warmup_compiles": warmup_compiles,
         "buckets": list(stats["buckets"]),
         "pid": os.getpid(),
         "telemetry": args.telemetry,
@@ -115,6 +141,12 @@ def main(argv=None):
     p_serve = sub.add_parser("serve")
     p_serve.add_argument("--out", required=True)
     p_serve.add_argument("--telemetry", default=None)
+    p_serve.add_argument(
+        "--max-warmup-compiles", type=int, default=None,
+        help="assert the engine warmup itself ran at most this many backend "
+        "compiles — 0 on a second run with a warm SE_TPU_COMPILE_CACHE "
+        "(persistent-cache disk hits emit no backend_compile events)",
+    )
     p_serve.set_defaults(fn=cmd_serve)
     args = parser.parse_args(argv)
     os.makedirs(args.out, exist_ok=True)
