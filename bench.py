@@ -713,6 +713,43 @@ def inner():
     except (OSError, json.JSONDecodeError):
         pass
 
+    # pipeline A/B (docs/pipeline.md): the same headline fit with the
+    # lookahead dispatch pipeline pinned OFF (SE_TPU_PIPELINE=0, the
+    # synchronous pre-pipeline path) vs ON at depth 1.  Pipeline depth is
+    # a driver-level knob — not part of any program-cache key — so both
+    # legs reuse the warmed programs and the delta is pure dispatch
+    # overlap; host_blocked_us (telemetry fit_end) records how long the
+    # host sat in blocking device reads in each leg.  Both legs run under
+    # record_fits so the telemetry cost cancels in the ratio.
+    def _pipeline_leg(depth):
+        prev = os.environ.get("SE_TPU_PIPELINE")
+        os.environ["SE_TPU_PIPELINE"] = str(depth)
+        try:
+            with record_fits() as rec:
+                _, leg_s = _timed_fit(est.copy(), X, y)
+            fend = next(
+                (e for e in rec.events if e.get("event") == "fit_end"), {}
+            )
+            blocked_s = float(fend.get("host_blocked_us") or 0.0) / 1e6
+            return leg_s, blocked_s
+        finally:
+            if prev is None:
+                os.environ.pop("SE_TPU_PIPELINE", None)
+            else:
+                os.environ["SE_TPU_PIPELINE"] = prev
+
+    sync_s, sync_blocked = _pipeline_leg(0)
+    pipe_s, pipe_blocked = _pipeline_leg(1)
+    pipeline_ab = {
+        "speedup": round(sync_s / pipe_s, 3),
+        "sync_fit_seconds": round(sync_s, 3),
+        "pipelined_fit_seconds": round(pipe_s, 3),
+        "sync_host_blocked_share": round(sync_blocked / max(sync_s, 1e-9), 4),
+        "pipelined_host_blocked_share": round(
+            pipe_blocked / max(pipe_s, 1e-9), 4
+        ),
+    }
+
     # tuned-vs-default (docs/autotune.md): the headline above resolved
     # every tunable through the published tuning cache (when one exists
     # for this device); re-measure the same fit + predict with autotuning
@@ -775,6 +812,8 @@ def inner():
             if lat else None
         ),
         "serving_compiles_after_warmup": serving_compiles,
+        "pipeline_speedup": pipeline_ab["speedup"],
+        "pipeline": pipeline_ab,
         "autotune": autotune_state,
         "tuned_vs_default": tuned_vs_default,
         "platform": platform,

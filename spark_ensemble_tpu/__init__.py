@@ -124,6 +124,11 @@ from spark_ensemble_tpu.autotune import (
     enable_compilation_cache,
     run_search,
 )
+from spark_ensemble_tpu.execution import (
+    device_patience_enabled,
+    resolve_pipeline_depth,
+)
+from spark_ensemble_tpu.models.base import shared_fit_context
 from spark_ensemble_tpu.utils.persist import load
 
 __version__ = "0.1.0"
@@ -201,5 +206,8 @@ __all__ = [
     "autotune_fit",
     "enable_compilation_cache",
     "run_search",
+    "resolve_pipeline_depth",
+    "device_patience_enabled",
+    "shared_fit_context",
     "load",
 ]

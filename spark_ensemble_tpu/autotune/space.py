@@ -135,6 +135,13 @@ TUNABLES = TunableSpace((
         "<=12.5% padding; more buckets = less padding, more traces)",
         site="models/base.py:_BUCKET_OCTAVE_STEPS",
     ),
+    Tunable(
+        "pipeline_depth", 1, (0, 1, 2),
+        doc="lookahead chunks kept in flight by the round drivers "
+        "(0 = fully synchronous dispatch; SE_TPU_PIPELINE env wins)",
+        site="execution.py:resolve_pipeline_depth",
+        kind="choice",
+    ),
 ))
 
 

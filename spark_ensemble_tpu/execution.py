@@ -1,0 +1,138 @@
+"""Lookahead dispatch pipeline: depth resolution + on-device patience.
+
+The round drivers (``models/gbm.py:_drive_rounds``,
+``models/boosting.py:_drive_boosting_rounds``) historically read every
+chunk's outputs back to the host *before* dispatching the next chunk, so
+the device idled during patience stepping, guard scans, telemetry fences
+and checkpoint bookkeeping — the dispatch-bound regime the only on-chip
+capture measured at 0.51% MFU.  JAX dispatch is asynchronous: a jitted
+call returns future arrays immediately and the host only blocks when it
+*reads* them.  The pipeline exploits exactly that: with depth ``k`` the
+driver keeps up to ``k`` speculative chunks enqueued past the chunk whose
+bookkeeping is being committed, so the device computes chunk ``j+1``
+while the host reads chunk ``j``.
+
+Exactness is preserved because member keys/masks derive from **absolute
+round indices**: a mid-chunk validation stop or a guard recovery simply
+discards the speculative in-flight chunks and rewinds the carry — replay
+(when needed) re-dispatches the same pure program over the same keys and
+is bit-identical.  ``SE_TPU_PIPELINE=0`` pins today's fully synchronous
+path (test-pinned bit-identity); unset, the depth comes from the
+autotuned ``pipeline_depth`` tunable (autotune/space.py).
+
+``SE_TPU_DEVICE_PATIENCE=1`` additionally moves the patience recurrence
+on-device: the chunk's per-round validation losses are folded through a
+``lax.scan`` inside one cached program and the host reads back four
+scalars (best, patience, stopped, kept) instead of stepping the loop in
+Python.  The device recurrence runs in float32 while the host reference
+steps in float64, so decisions can diverge at tolerance boundaries —
+that is why it is opt-in and OFF by default (docs/pipeline.md).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PIPELINE_ENV = "SE_TPU_PIPELINE"
+DEVICE_PATIENCE_ENV = "SE_TPU_DEVICE_PATIENCE"
+
+#: deepest supported lookahead window; beyond 2 the host is never the
+#: bottleneck and speculative work wasted on a stop grows linearly
+MAX_PIPELINE_DEPTH = 2
+
+#: default depth — must mirror the ``pipeline_depth`` tunable's default
+#: (autotune/space.py bit-identity contract)
+DEFAULT_PIPELINE_DEPTH = 1
+
+
+def resolve_pipeline_depth(n_rows: Optional[int] = None) -> int:
+    """Lookahead depth for a fit over ``n_rows`` training rows.
+
+    Resolution order: ``SE_TPU_PIPELINE`` (clamped to
+    ``[0, MAX_PIPELINE_DEPTH]``; non-integer values are ignored) wins
+    over the autotuned ``pipeline_depth`` tunable, which falls back to
+    :data:`DEFAULT_PIPELINE_DEPTH`.  Read per fit, not at import, so a
+    test or bench leg can flip the env between fits.
+    """
+    raw = os.environ.get(PIPELINE_ENV)
+    if raw is not None and raw.strip():
+        try:
+            return max(0, min(MAX_PIPELINE_DEPTH, int(raw)))
+        except ValueError:
+            pass  # unparsable env degrades to the tunable, not a crash
+    from spark_ensemble_tpu.autotune.resolve import resolve
+
+    depth = resolve("pipeline_depth", DEFAULT_PIPELINE_DEPTH, n=n_rows)
+    try:
+        return max(0, min(MAX_PIPELINE_DEPTH, int(depth)))
+    except (TypeError, ValueError):
+        return DEFAULT_PIPELINE_DEPTH
+
+
+def device_patience_enabled() -> bool:
+    """Whether the opt-in on-device patience recurrence is active."""
+    return os.environ.get(DEVICE_PATIENCE_ENV, "") not in ("", "0")
+
+
+def _patience_scan_program():
+    """One cached program folding a chunk's validation losses through the
+    patience recurrence (the device twin of
+    ``_GBMParams._patience_step``).  Scalar inputs are traced, so a
+    single program serves every estimator; the errs length retraces per
+    chunk size (bounded by the handful of distinct chunk tails)."""
+    from spark_ensemble_tpu.models.base import cached_program
+
+    def build():
+        def run(errs, best0, v0, tol, limit):
+            def step(carry, err):
+                best, v, done, kept = carry
+                no_improve = (best - err) < tol * jnp.maximum(err, 0.01)
+                new_v = jnp.where(no_improve, v + 1, 0)
+                new_best = jnp.where(no_improve, best, err)
+                stop_now = jnp.logical_and(
+                    jnp.logical_not(done), new_v >= limit
+                )
+                best = jnp.where(done, best, new_best)
+                v = jnp.where(done, v, new_v)
+                kept = jnp.where(done, kept, kept + 1)
+                done = jnp.logical_or(done, stop_now)
+                return (best, v, done, kept), None
+
+            init = (
+                jnp.float32(best0),
+                jnp.int32(v0),
+                jnp.bool_(False),
+                jnp.int32(0),
+            )
+            (best, v, done, kept), _ = jax.lax.scan(
+                step, init, jnp.asarray(errs, jnp.float32)
+            )
+            return best, v, done, kept
+
+        return jax.jit(run)
+
+    return cached_program(("device_patience_scan",), build)
+
+
+def device_patience_step(
+    errs, best: float, v: int, tol: float, limit: int
+) -> Tuple[float, int, bool, int]:
+    """Fold a chunk's per-round validation losses on-device and read back
+    four scalars: ``(best, v, stopped, kept)`` where ``kept`` counts the
+    rounds up to AND INCLUDING the stopping round.  ``best`` comes back
+    as float32 — callers carrying it across chunks stay in the device's
+    precision by construction."""
+    prog = _patience_scan_program()
+    b0 = np.float32(np.inf) if not np.isfinite(best) else np.float32(best)
+    best_a, v_a, done_a, kept_a = prog(
+        errs, b0, np.int32(v), np.float32(tol), np.int32(limit)
+    )
+    best_h, v_h, done_h, kept_h = jax.device_get(
+        (best_a, v_a, done_a, kept_a)
+    )
+    return float(best_h), int(v_h), bool(done_h), int(kept_h)

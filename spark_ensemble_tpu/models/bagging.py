@@ -42,6 +42,7 @@ from spark_ensemble_tpu.models.base import (
     as_f32,
     cached_program,
     infer_num_classes,
+    make_shared_fit_ctx,
     resolve_weights,
 )
 from spark_ensemble_tpu.models.tree import (
@@ -278,7 +279,7 @@ class BaggingRegressor(_BaggingParams):
         # snapshot the base learner: cached round-step closures must not
         # observe later set_params mutations of the caller's instance
         base = self._base().copy()
-        ctx = base.make_fit_ctx(X)
+        ctx = make_shared_fit_ctx(base, X)
         fit_w, masks, keys = self._member_plan(n, d, w)
         member_masks = masks
         ctx_specs = ax = mem = None
@@ -369,7 +370,7 @@ class BaggingClassifier(_BaggingParams):
         # snapshot the base learner: cached round-step closures must not
         # observe later set_params mutations of the caller's instance
         base = self._base().copy()
-        ctx = base.make_fit_ctx(X, num_classes)
+        ctx = make_shared_fit_ctx(base, X, num_classes)
         fit_w, masks, keys = self._member_plan(n, d, w)
         member_masks = masks
         ctx_specs = ax = mem = None

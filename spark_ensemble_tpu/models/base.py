@@ -127,6 +127,61 @@ def cached_program(key: tuple, build: Callable[[], Callable]) -> Callable:
 
 
 # ---------------------------------------------------------------------------
+# shared fit-context scope (docs/pipeline.md: tuning-layer binning reuse)
+# ---------------------------------------------------------------------------
+#
+# `BaseLearner.make_fit_ctx` computes dataset preprocessing (quantile
+# binning, feature stats) that depends only on (X, learner config,
+# num_classes).  A tuning sweep fits the SAME X under many (param-map,
+# fold) combos — with weight-mask folds every fit sees the identical full
+# matrix, so recomputing the binning per fit is pure waste.  Inside a
+# `shared_fit_context()` scope the family fits route through
+# `make_shared_fit_ctx`, which memoizes per (X identity, shape/dtype,
+# learner config, num_classes); outside a scope it degrades to a plain
+# `make_fit_ctx` call, so per-fit behavior is unchanged.
+
+_FIT_CTX_SCOPE = threading.local()
+
+
+def shared_fit_context():
+    """Context manager activating a fit-ctx memo for the enclosed fits
+    (nests by stacking: the inner scope wins, the outer is restored)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _scope():
+        prev = getattr(_FIT_CTX_SCOPE, "cache", None)
+        _FIT_CTX_SCOPE.cache = {}
+        try:
+            yield
+        finally:
+            _FIT_CTX_SCOPE.cache = prev
+
+    return _scope()
+
+
+def make_shared_fit_ctx(learner, X, num_classes: Optional[int] = None):
+    """``learner.make_fit_ctx(X, num_classes)`` memoized under the active
+    :func:`shared_fit_context` scope (one binning pass per distinct
+    dataset/config), or computed directly when no scope is active.
+
+    Keyed by ``id(X)`` plus shape/dtype and the learner's ``config_key()``
+    — the X reference is pinned in the cache entry, so a recycled ``id``
+    cannot alias a different matrix within a scope."""
+    cache = getattr(_FIT_CTX_SCOPE, "cache", None)
+    if cache is None:
+        return learner.make_fit_ctx(X, num_classes)
+    shape = tuple(getattr(X, "shape", ())) or (len(X),)
+    dtype = str(getattr(X, "dtype", ""))
+    key = (id(X), shape, dtype, learner.config_key(), num_classes)
+    hit = cache.get(key)
+    if hit is None:
+        hit = (X, learner.make_fit_ctx(X, num_classes))
+        cache[key] = hit
+    return hit[1]
+
+
+# ---------------------------------------------------------------------------
 # predict-path shape bucketing (docs/serving.md)
 # ---------------------------------------------------------------------------
 #
@@ -787,7 +842,7 @@ class BaseLearner(Estimator):
         num_classes = (
             infer_num_classes(y, num_classes) if self.is_classifier else None
         )
-        ctx = self.make_fit_ctx(X, num_classes)
+        ctx = make_shared_fit_ctx(self, X, num_classes)
         key = jax.random.PRNGKey(getattr(self, "seed", 0) or 0)
         if mesh is None:
             params = self.fit_from_ctx(ctx, y, w, None, key)

@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import logging
 import time
+from collections import deque
 from typing import Any, List, Optional
 
 import jax
@@ -54,6 +55,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from spark_ensemble_tpu.compat import shard_map
 
+from spark_ensemble_tpu import execution as _execution
 from spark_ensemble_tpu.models.base import (
     BaseLearner,
     CheckpointableParams,
@@ -63,6 +65,7 @@ from spark_ensemble_tpu.models.base import (
     as_f32,
     cached_program,
     infer_num_classes,
+    make_shared_fit_ctx,
     resolve_weights,
     resolved_scan_chunk,
 )
@@ -212,30 +215,47 @@ class _BoostingParams(CheckpointableParams, Estimator):
 
         i = start_i
         chunk = resolved_scan_chunk(self, int(bw.shape[0]))
+        # lookahead window past the committing chunk (docs/pipeline.md);
+        # the boosting carry is just ``bw``, which run_chunk threads
+        # explicitly, so speculation chains the weight futures directly
+        depth = _execution.resolve_pipeline_depth(int(bw.shape[0]))
         # a checkpoint resume starts at the full chunk: start_i kept rounds
         # already outweigh the worst-case discard of one fixed-size chunk
         probe = ramp and self.ramp == "auto" and start_i == 0
         cur = 1 if probe else chunk
         stop = float(jnp.sum(bw)) <= 0
-        while i < self.num_base_learners and not stop:
-            c = min(cur, self.num_base_learners - i)
-            cur = chunk  # probe survived (or no probe): full chunks from here
-            if ckpt.enabled:
-                c = min(c, ckpt.rounds_until_save(i))
-            keys = jax.vmap(lambda j: jax.random.fold_in(root, j))(
-                jnp.arange(i, i + c)
-            )
-            t_chunk = time.perf_counter()
-            bw_prev = bw
-            params_c, est_ws, sum_bws, bw, extras = dispatch(keys, bw, i)
+
+        def to_host(sum_bws, extras):
+            # extras stay on device through dispatch so a speculative chunk
+            # is never read; the commit path converts exactly once
+            sum_bws = np.asarray(sum_bws)
+            if isinstance(extras, tuple):
+                extras = tuple(np.asarray(e) for e in extras)
+            elif extras is not None:
+                extras = np.asarray(extras)
+            return sum_bws, extras
+
+        def commit(i, c, keys, bw_prev, t_chunk,
+                   params_c, est_ws, sum_bws, bw_out, extras):
+            """One dispatched chunk's host bookkeeping (guard scan, abort
+            replay, telemetry, slice-append, gated save, preemption point)
+            -> (i, bw, stop, rewound)."""
+            bw = bw_out
+            stop = False
             skip_after = 0  # guard-dropped rounds: consume the index, no member
             halt = False
+            rewound = False
+            if telem is not None and telem.enabled:
+                # host-blocked accounting: the read this chunk's commit
+                # waits on (docs/pipeline.md)
+                telem.blocking_read((params_c, est_ws, sum_bws, extras))
             bad = (
                 guard.first_nonfinite(params_c, est_ws, sum_bws, extras)
                 if guard_on
                 else None
             )
             if bad is not None:
+                rewound = True
                 if guard.policy == "raise":
                     guard.raise_error(i + bad)
                 action = (
@@ -261,7 +281,7 @@ class _BoostingParams(CheckpointableParams, Estimator):
                 else:
                     skip_after = 1
             if c > 0:
-                sum_bws = np.asarray(sum_bws)
+                sum_bws, extras = to_host(sum_bws, extras)
                 kept, stop = replay(extras, sum_bws, c, i)
                 if telem is not None and telem.enabled:
                     # classifier extras = per-round errs; Drucker extras =
@@ -298,6 +318,76 @@ class _BoostingParams(CheckpointableParams, Estimator):
                 )
             if not stop:
                 ctl.preempt(f"{label}:after_round:{i}")
+            return i, bw, stop, rewound
+
+        if depth == 0:
+            # synchronous path: one chunk in flight, outputs read before
+            # the next dispatch (pinned by tests/test_pipeline_exec.py)
+            while i < self.num_base_learners and not stop:
+                c = min(cur, self.num_base_learners - i)
+                cur = chunk  # probe survived (or no probe): full chunks now
+                if ckpt.enabled:
+                    c = min(c, ckpt.rounds_until_save(i))
+                keys = jax.vmap(lambda j: jax.random.fold_in(root, j))(
+                    jnp.arange(i, i + c)
+                )
+                t_chunk = time.perf_counter()
+                bw_prev = bw
+                params_c, est_ws, sum_bws, bw_out, extras = dispatch(
+                    keys, bw, i
+                )
+                i, bw, stop, _ = commit(
+                    i, c, keys, bw_prev, t_chunk,
+                    params_c, est_ws, sum_bws, bw_out, extras,
+                )
+            # join the in-flight async save before the model is assembled
+            ckpt.wait()
+            return i
+
+        # -- lookahead pipeline: chunk j+1 is enqueued on chunk j's weight
+        # futures before any host read of chunk j.  An abort, a guard
+        # rewind or a weight-mass stop invalidates everything still in
+        # flight (speculative outputs are discarded unread; fold_in keys
+        # derive from absolute round indices, so any replay is
+        # bit-identical).  The probe chunk commits alone first — it exists
+        # because round-0 aborts are the common case, and speculating past
+        # it would waste a full chunk on every such abort.
+        pending: deque = deque()
+        i_disp = i
+        bw_frontier = bw
+        probe_pending = probe
+
+        def speculate():
+            nonlocal i_disp, bw_frontier, cur
+            c = min(cur, self.num_base_learners - i_disp)
+            cur = chunk
+            if ckpt.enabled:
+                c = min(c, ckpt.rounds_until_save(i_disp))
+            keys = jax.vmap(lambda j: jax.random.fold_in(root, j))(
+                jnp.arange(i_disp, i_disp + c)
+            )
+            t0 = time.perf_counter()
+            bw_prev = bw_frontier
+            out = dispatch(keys, bw_prev, i_disp)
+            pending.append((i_disp, c, keys, bw_prev, t0) + out)
+            i_disp += c
+            bw_frontier = out[3]
+
+        while i < self.num_base_learners and not stop:
+            window = 1 if probe_pending else depth + 1
+            while i_disp < self.num_base_learners and len(pending) < window:
+                speculate()
+            (i0, c, keys, bw_prev, t0,
+             params_c, est_ws, sum_bws, bw_out, extras) = pending.popleft()
+            probe_pending = False
+            i, bw, stop, rewound = commit(
+                i0, c, keys, bw_prev, t0,
+                params_c, est_ws, sum_bws, bw_out, extras,
+            )
+            if rewound or stop:
+                pending.clear()
+                i_disp = i
+                bw_frontier = bw
         # join the in-flight async save before the model is assembled
         ckpt.wait()
         return i
@@ -338,7 +428,7 @@ class BoostingClassifier(_BoostingParams):
         # snapshot the base learner: cached round-step closures must not
         # observe later set_params mutations of the caller's instance
         base = self._base().copy()
-        ctx = base.make_fit_ctx(X, num_classes)
+        ctx = make_shared_fit_ctx(base, X, num_classes)
         algorithm = self.algorithm.lower()
         k = num_classes
         root = jax.random.PRNGKey(self.seed)
@@ -453,7 +543,9 @@ class BoostingClassifier(_BoostingParams):
 
         def run_chunk(keys, bw):
             params_c, errs, est_ws, sum_bws, bw = chunk_step(ctx, X, y, bw, keys)
-            return params_c, est_ws, sum_bws, bw, np.asarray(errs)
+            # errs stay on device: the driver converts at commit time, so a
+            # speculative dispatch never blocks the host (docs/pipeline.md)
+            return params_c, est_ws, sum_bws, bw, errs
 
         bw = w
         members_chunks: List[Any] = []
@@ -600,7 +692,7 @@ class BoostingRegressor(_BoostingParams):
         # snapshot the base learner: cached round-step closures must not
         # observe later set_params mutations of the caller's instance
         base = self._base().copy()
-        ctx = base.make_fit_ctx(X)
+        ctx = make_shared_fit_ctx(base, X)
         root = jax.random.PRNGKey(self.seed)
         # snapshot the loss name: the cached closure must not read `self.loss`
         # at (re)trace time — set_params(loss=...) after fit would otherwise
@@ -724,13 +816,9 @@ class BoostingRegressor(_BoostingParams):
             params_c, max_errs, est_errs, est_ws, sum_bws, bw = chunk_step(
                 ctx, X, y, valid, bw, keys
             )
-            return (
-                params_c,
-                est_ws,
-                sum_bws,
-                bw,
-                (np.asarray(max_errs), np.asarray(est_errs)),
-            )
+            # extras stay on device: converted once at commit time, so a
+            # speculative dispatch never blocks the host (docs/pipeline.md)
+            return params_c, est_ws, sum_bws, bw, (max_errs, est_errs)
 
         bw = w
         members_chunks: List[Any] = []

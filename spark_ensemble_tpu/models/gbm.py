@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import logging
 import time
+from collections import deque
 from typing import Any, List, Optional
 
 import jax
@@ -61,6 +62,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from spark_ensemble_tpu.compat import shard_map
 
+from spark_ensemble_tpu import execution as _execution
 from spark_ensemble_tpu.models.base import (
     BaseLearner,
     CheckpointableParams,
@@ -70,6 +72,7 @@ from spark_ensemble_tpu.models.base import (
     as_f32,
     cached_program,
     infer_num_classes,
+    make_shared_fit_ctx,
     member_leaves,
     mesh_fit_kwargs,
     resolve_weights,
@@ -323,6 +326,17 @@ class _GBMParams(CheckpointableParams, Estimator):
         retry_policy = self._retry_policy()
         ctl = controller()
         guard_on = guard is not None and guard.active
+        # lookahead window (docs/pipeline.md): chunks kept in flight past
+        # the one being committed; 0 pins the fully synchronous pre-pipeline
+        # path.  Speculation needs the carry rewind hooks to keep
+        # checkpoints crash-consistent, so depth degrades to 0 without them.
+        depth = (
+            _execution.resolve_pipeline_depth(n_rows)
+            if snapshot is not None and restore is not None
+            else 0
+        )
+        # opt-in on-device patience recurrence (f32 — see execution.py)
+        dp_on = _execution.device_patience_enabled()
 
         def dispatch(sl, step_scale=1.0):
             site = f"{label}:round:{sl.start}"
@@ -351,20 +365,34 @@ class _GBMParams(CheckpointableParams, Estimator):
             weights_chunks.append(weights_c)
             stopped = False
             if errs is not None:
-                for j, err in enumerate(np.asarray(errs)):
+                if dp_on:
+                    # device recurrence: the host reads four scalars per
+                    # chunk instead of stepping the loop per round (the
+                    # per-round log lines are skipped in this mode)
+                    best, v, stopped, kept = _execution.device_patience_step(
+                        errs, best, v, self.validation_tol, self.num_rounds
+                    )
                     if val_history is not None:
-                        val_history.append(float(err))
-                    best, v = self._patience_step(
-                        best, float(err), v, self.validation_tol
-                    )
-                    logger.info(
-                        "%s round %d: val_loss=%.6f patience=%d",
-                        label, i + j, float(err), v,
-                    )
-                    if v >= self.num_rounds:
-                        i += j + 1
-                        stopped = True
-                        break
+                        val_history.extend(
+                            float(e) for e in np.asarray(errs)[:kept]
+                        )
+                    if stopped:
+                        i += kept
+                else:
+                    for j, err in enumerate(np.asarray(errs)):
+                        if val_history is not None:
+                            val_history.append(float(err))
+                        best, v = self._patience_step(
+                            best, float(err), v, self.validation_tol
+                        )
+                        logger.info(
+                            "%s round %d: val_loss=%.6f patience=%d",
+                            label, i + j, float(err), v,
+                        )
+                        if v >= self.num_rounds:
+                            i += j + 1
+                            stopped = True
+                            break
             if not stopped:
                 i += c
                 save_state(i - 1, v, best)
@@ -443,36 +471,126 @@ class _GBMParams(CheckpointableParams, Estimator):
             return i, v, best, False
 
         halt = False
-        while not halt and i < self.num_base_learners and v < self.num_rounds:
-            c = min(chunk, self.num_base_learners - i)
+        if depth == 0:
+            # the synchronous path, kept verbatim: every chunk's outputs
+            # are read before the next chunk is enqueued (pinned
+            # bit-identical by tests/test_pipeline_exec.py)
+            while (
+                not halt and i < self.num_base_learners
+                and v < self.num_rounds
+            ):
+                c = min(chunk, self.num_base_learners - i)
+                if ckpt.enabled:
+                    # end the chunk exactly on the next save boundary: keeps
+                    # periodic saves firing at any resume offset, including a
+                    # resume under a CHANGED checkpoint_interval
+                    c = min(c, ckpt.rounds_until_save(i))
+                snap = (
+                    snapshot()
+                    if (guard_on and snapshot is not None)
+                    else None
+                )
+                t_chunk = time.perf_counter()
+                params_c, weights_c, errs = dispatch(slice(i, i + c))
+                if telem is not None and telem.enabled:
+                    # host-blocked accounting (pure fence — no math): the
+                    # wait this pipeline exists to overlap, measured so the
+                    # A/B is observable rather than inferred
+                    telem.blocking_read((params_c, weights_c, errs))
+                bad = (
+                    guard.first_nonfinite(params_c, weights_c, errs)
+                    if guard_on
+                    else None
+                )
+                if bad is None:
+                    i, v, best, _ = process(
+                        i, c, t_chunk, params_c, weights_c, errs, v, best
+                    )
+                else:
+                    i, v, best, halt = recover(
+                        i, c, bad, snap, params_c, weights_c, errs, v, best
+                    )
+                # chaos: a mid-training preemption lands here — after the
+                # chunk's periodic save, so kill-and-resume tests exercise a
+                # real checkpoint boundary
+                ctl.preempt(f"{label}:after_round:{i}")
+            # the loop must not end with a dangling background write: join
+            # the in-flight async save (and surface its failure) before the
+            # model is assembled
+            ckpt.wait()
+            return i, v, best
+
+        # -- lookahead pipeline (docs/pipeline.md) -------------------------
+        #
+        # Up to ``depth`` chunks stay enqueued past the one being committed:
+        # dispatch is async, so the device computes chunk j+1 while the host
+        # reads chunk j.  Each pending entry carries TWO carry snapshots:
+        # ``snap_pre`` (chunk start — the guard's rewind point) and
+        # ``snap_post`` (chunk end — the state ``save_state`` must see, so a
+        # speculative chunk is never persisted before its predecessor's
+        # bookkeeping commits).  A mid-chunk stop or a flagged chunk
+        # invalidates everything still in flight: speculative outputs are
+        # discarded unread and the carry rewinds; replay is bit-identical
+        # because member keys/masks derive from absolute round indices.
+        pending: deque = deque()
+        i_disp = i  # dispatch frontier (absolute round index)
+
+        def speculate():
+            nonlocal i_disp
+            c = min(chunk, self.num_base_learners - i_disp)
             if ckpt.enabled:
-                # end the chunk exactly on the next save boundary: keeps
-                # periodic saves firing at any resume offset, including a
-                # resume under a CHANGED checkpoint_interval
-                c = min(c, ckpt.rounds_until_save(i))
-            snap = snapshot() if (guard_on and snapshot is not None) else None
-            t_chunk = time.perf_counter()
-            params_c, weights_c, errs = dispatch(slice(i, i + c))
+                c = min(c, ckpt.rounds_until_save(i_disp))
+            snap_pre = snapshot() if guard_on else None
+            t0 = time.perf_counter()
+            params_c, weights_c, errs = dispatch(slice(i_disp, i_disp + c))
+            pending.append(
+                (i_disp, c, snap_pre, snapshot(), t0,
+                 params_c, weights_c, errs)
+            )
+            i_disp += c
+
+        while not halt and i < self.num_base_learners and v < self.num_rounds:
+            while i_disp < self.num_base_learners and len(pending) <= depth:
+                speculate()
+            i0, c, snap_pre, snap_post, t0, params_c, weights_c, errs = (
+                pending.popleft()
+            )
+            if telem is not None and telem.enabled:
+                telem.blocking_read((params_c, weights_c, errs))
             bad = (
                 guard.first_nonfinite(params_c, weights_c, errs)
                 if guard_on
                 else None
             )
             if bad is None:
-                i, v, best, _ = process(
-                    i, c, t_chunk, params_c, weights_c, errs, v, best
+                speculated = bool(pending)
+                frontier = snapshot() if speculated else None
+                if speculated:
+                    # commit under the chunk's own end-state so save_state
+                    # persists committed arrays, not the speculative frontier
+                    restore(snap_post)
+                i, v, best, stopped = process(
+                    i0, c, t0, params_c, weights_c, errs, v, best
                 )
+                if stopped:
+                    # mid-chunk validation stop: the in-flight chunks were
+                    # dispatched for rounds that no longer exist — discard
+                    pending.clear()
+                    i_disp = i
+                elif speculated:
+                    restore(frontier)
             else:
+                if pending:
+                    # rewind to the sync-equivalent carry (this chunk's
+                    # dispatch output) before recovery, and drop the
+                    # speculative chunks built on the poisoned state
+                    pending.clear()
+                    restore(snap_post)
                 i, v, best, halt = recover(
-                    i, c, bad, snap, params_c, weights_c, errs, v, best
+                    i0, c, bad, snap_pre, params_c, weights_c, errs, v, best
                 )
-            # chaos: a mid-training preemption lands here — after the
-            # chunk's periodic save, so kill-and-resume tests exercise a
-            # real checkpoint boundary
+                i_disp = i
             ctl.preempt(f"{label}:after_round:{i}")
-        # the loop must not end with a dangling background write: join the
-        # in-flight async save (and surface its failure) before the model
-        # is assembled
         ckpt.wait()
         return i, v, best
 
@@ -705,7 +823,7 @@ class GBMRegressor(_GBMParams):
         # snapshot the base learner: cached round-step closures must not
         # observe later set_params mutations of the caller's instance
         base = self._base().copy()
-        ctx = base.make_fit_ctx(X)
+        ctx = make_shared_fit_ctx(base, X)
         bag_keys, masks = self._sampling_plan(n, d)
 
         init_model = self._fit_init(X, y, w, mesh=mesh)
@@ -1242,7 +1360,7 @@ class GBMClassifier(_GBMParams):
         # snapshot the base learner: cached round-step closures must not
         # observe later set_params mutations of the caller's instance
         base = self._base().copy()
-        ctx = base.make_fit_ctx(X)
+        ctx = make_shared_fit_ctx(base, X)
         bag_keys, masks = self._sampling_plan(n, d)
         loss = self._make_loss(num_classes)
         dim = loss.dim

@@ -311,6 +311,7 @@ class FitTelemetry:
         self._events: List[Dict[str, Any]] = []
         self._phases: Dict[str, float] = {}
         self._rounds = 0
+        self._host_blocked_s = 0.0
         self._finished = False
         self._t0 = time.perf_counter()
         self._last_mark = self._t0
@@ -384,6 +385,22 @@ class FitTelemetry:
             dt = time.perf_counter() - t0
             with self._lock:
                 self._phases[name] = self._phases.get(name, 0.0) + dt
+
+    def host_blocked(self, seconds: float) -> None:
+        """Charge ``seconds`` of driver time spent blocked on a device
+        read between dispatches (the serialization the lookahead pipeline
+        exists to hide — docs/pipeline.md); accumulated per fit and
+        reported as ``host_blocked_us`` on ``fit_end``."""
+        with self._lock:
+            self._host_blocked_s += float(seconds)
+
+    def blocking_read(self, fence: Any) -> None:
+        """Fence on ``fence`` (any pytree of device arrays) and charge the
+        wait to the host-blocked accumulator — the one call the round
+        drivers make before touching a chunk's outputs."""
+        t0 = time.perf_counter()
+        block_on_arrays(fence)
+        self.host_blocked(time.perf_counter() - t0)
 
     def round_chunk(self, start_round: int, count: int, t0: float,
                     fence: Any = (), losses: Any = None, step_sizes: Any = None,
@@ -499,6 +516,7 @@ class FitTelemetry:
             "phases": phases,
             "compile_count": c1 - self._compile0[0],
             "compile_s": s1 - self._compile0[1],
+            "host_blocked_us": self._host_blocked_s * 1e6,
         }
         mem = device_memory_stats()
         if mem:
@@ -609,6 +627,12 @@ class _DisabledFitTelemetry(FitTelemetry):
 
     def round_chunk(self, *a, **kw):
         return 0.0
+
+    def host_blocked(self, seconds):
+        pass
+
+    def blocking_read(self, fence):
+        pass
 
     def member_fit(self, *a, **kw):
         pass

@@ -10,15 +10,22 @@ Design notes (vs Spark):
   uses per-row Bernoulli hashing); folds are near-equal-sized.
 - Each (param-map, fold) fit is an independent jit-compiled program run in a
   host loop — the analogue of ``CrossValidator``'s driver-side ``Future``
-  pool (`parallelism` is accepted for API parity).  Homogeneous-config
-  sweeps reuse each estimator's cached round-step compilations across folds
-  because shapes match fold-to-fold.
+  pool (`parallelism` is accepted for API parity).
+- Folds are **weight masks**, not row subsets: every candidate fits on the
+  FULL feature matrix with held-out rows carrying ``sample_weight = 0``
+  (inert in every estimator — GBM stats, boosting reweighting and bagging
+  resampling all scale by the weight), and evaluates on the held-out rows
+  with their true weights.  Identical shapes across folds mean every fold
+  reuses the same compiled round programs AND — via ``share_binning`` —
+  the same feature-binning fit context, computed once per search instead
+  of once per (param-map, fold) candidate.
 - ``CrossValidatorModel.avg_metrics`` matches Spark's name/meaning; the
   best map refits on the full data, like Spark.
 """
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import logging
 from typing import Any, Dict, List, Optional, Sequence
@@ -27,7 +34,13 @@ import jax
 import numpy as np
 
 from spark_ensemble_tpu.evaluation import Evaluator
-from spark_ensemble_tpu.models.base import Estimator, Model, mesh_fit_kwargs
+from spark_ensemble_tpu.models.base import (
+    Estimator,
+    Model,
+    as_f32,
+    mesh_fit_kwargs,
+    shared_fit_context,
+)
 from spark_ensemble_tpu.params import Param, gt_eq, in_range
 
 logger = logging.getLogger(__name__)
@@ -98,14 +111,19 @@ def _fit_and_eval(
     estimator, pmap, evaluator, X, y, w, train_mask, eval_mask,
     num_classes=None, mesh=None,
 ):
+    """Weight-mask fold fit: train on the FULL ``X``/``y`` with held-out
+    rows zero-weighted (inert — every estimator scales its statistics by
+    the sample weight), evaluate on the held-out rows with their true
+    weights.  Keeping ``X`` whole keeps every candidate's input shapes —
+    and its ``id(X)``-keyed shared fit context — identical across folds."""
     est = estimator.copy(**pmap)
     kw = _mesh_kw(est, mesh)
-    Xt, yt = X[train_mask], y[train_mask]
-    wt = w[train_mask] if w is not None else None
+    base_w = w if w is not None else np.ones((X.shape[0],), np.float32)
+    wt = np.where(train_mask, base_w, 0.0).astype(np.float32)
     if num_classes is not None:
-        model = est.fit(Xt, yt, sample_weight=wt, num_classes=num_classes, **kw)
+        model = est.fit(X, y, sample_weight=wt, num_classes=num_classes, **kw)
     else:
-        model = est.fit(Xt, yt, sample_weight=wt, **kw)
+        model = est.fit(X, y, sample_weight=wt, **kw)
     Xe, ye = X[eval_mask], y[eval_mask]
     we = w[eval_mask] if w is not None else None
     return model, evaluator.evaluate(model, Xe, ye, sample_weight=we)
@@ -123,9 +141,26 @@ class _TuningParams(Estimator):
     )
     parallelism = Param(1, gt_eq(1), doc="API parity; fits run back-to-back")
     seed = Param(0, doc="fold-split PRNG seed")
+    share_binning = Param(
+        True,
+        doc="compute each base-learner family's fit context (feature "
+        "binning / bin assignment) ONCE per search and reuse it across "
+        "param maps, folds and the best-map refit — sound because "
+        "weight-mask folds fit every candidate on the identical full X.  "
+        "Toggling only skips the memoization; scores are bit-identical "
+        "either way (distinct binning configs in the grid still get "
+        "distinct contexts via the learner's config key)",
+    )
 
     def _maps(self) -> List[Dict[str, Any]]:
         return list(self.estimator_param_maps or [{}])
+
+    def _binning_scope(self):
+        """Context manager the search loop runs under: a shared fit-ctx
+        scope when ``share_binning``, else a no-op."""
+        if self.share_binning:
+            return shared_fit_context()
+        return contextlib.nullcontext()
 
 
 class CrossValidator(_TuningParams):
@@ -137,7 +172,7 @@ class CrossValidator(_TuningParams):
         """Fit; ``mesh`` flows into every (param-map, fold) estimator fit,
         so each candidate trains distributed — the analogue of Spark CV
         launching cluster jobs per fold."""
-        X = np.asarray(X)
+        X = as_f32(np.asarray(X))  # one conversion => id-stable across fits
         y = np.asarray(y)
         w = None if sample_weight is None else np.asarray(sample_weight)
         evaluator: Evaluator = self.evaluator
@@ -145,21 +180,24 @@ class CrossValidator(_TuningParams):
         folds = _kfold_indices(X.shape[0], self.num_folds, self.seed)
         metrics = np.zeros((len(maps), self.num_folds))
         k = _full_num_classes(self.estimator, y)
-        for fi, eval_mask in enumerate(folds):
-            train_mask = ~eval_mask
-            for mi, pmap in enumerate(maps):
-                _, metric = _fit_and_eval(
-                    self.estimator, pmap, evaluator, X, y, w, train_mask,
-                    eval_mask, num_classes=k, mesh=mesh,
-                )
-                metrics[mi, fi] = metric
-                logger.info("CV fold %d map %d: %.5f", fi, mi, metric)
-        avg = metrics.mean(axis=1)
-        best_idx = int(np.argmax(avg) if evaluator.is_larger_better else np.argmin(avg))
-        best_est = self.estimator.copy(**maps[best_idx])
-        best_model = best_est.fit(
-            X, y, sample_weight=w, **_mesh_kw(best_est, mesh)
-        )
+        with self._binning_scope():
+            for fi, eval_mask in enumerate(folds):
+                train_mask = ~eval_mask
+                for mi, pmap in enumerate(maps):
+                    _, metric = _fit_and_eval(
+                        self.estimator, pmap, evaluator, X, y, w, train_mask,
+                        eval_mask, num_classes=k, mesh=mesh,
+                    )
+                    metrics[mi, fi] = metric
+                    logger.info("CV fold %d map %d: %.5f", fi, mi, metric)
+            avg = metrics.mean(axis=1)
+            best_idx = int(
+                np.argmax(avg) if evaluator.is_larger_better else np.argmin(avg)
+            )
+            best_est = self.estimator.copy(**maps[best_idx])
+            best_model = best_est.fit(
+                X, y, sample_weight=w, **_mesh_kw(best_est, mesh)
+            )
         return CrossValidatorModel(
             best_model=best_model,
             avg_metrics=avg.tolist(),
@@ -207,7 +245,7 @@ class TrainValidationSplit(_TuningParams):
         self, X, y, sample_weight=None, mesh=None
     ) -> "TrainValidationSplitModel":
         """Fit; ``mesh`` flows into every candidate fit (see CrossValidator)."""
-        X = np.asarray(X)
+        X = as_f32(np.asarray(X))  # one conversion => id-stable across fits
         y = np.asarray(y)
         w = None if sample_weight is None else np.asarray(sample_weight)
         evaluator: Evaluator = self.evaluator
@@ -220,20 +258,23 @@ class TrainValidationSplit(_TuningParams):
         eval_mask = ~train_mask
         metrics = np.zeros((len(maps),))
         k = _full_num_classes(self.estimator, y)
-        for mi, pmap in enumerate(maps):
-            _, metric = _fit_and_eval(
-                self.estimator, pmap, evaluator, X, y, w, train_mask,
-                eval_mask, num_classes=k, mesh=mesh,
+        with self._binning_scope():
+            for mi, pmap in enumerate(maps):
+                _, metric = _fit_and_eval(
+                    self.estimator, pmap, evaluator, X, y, w, train_mask,
+                    eval_mask, num_classes=k, mesh=mesh,
+                )
+                metrics[mi] = metric
+                logger.info("TVS map %d: %.5f", mi, metric)
+            best_idx = int(
+                np.argmax(metrics)
+                if evaluator.is_larger_better
+                else np.argmin(metrics)
             )
-            metrics[mi] = metric
-            logger.info("TVS map %d: %.5f", mi, metric)
-        best_idx = int(
-            np.argmax(metrics) if evaluator.is_larger_better else np.argmin(metrics)
-        )
-        best_est = self.estimator.copy(**maps[best_idx])
-        best_model = best_est.fit(
-            X, y, sample_weight=w, **_mesh_kw(best_est, mesh)
-        )
+            best_est = self.estimator.copy(**maps[best_idx])
+            best_model = best_est.fit(
+                X, y, sample_weight=w, **_mesh_kw(best_est, mesh)
+            )
         return TrainValidationSplitModel(
             best_model=best_model,
             validation_metrics=metrics.tolist(),

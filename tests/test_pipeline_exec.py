@@ -1,0 +1,196 @@
+"""Lookahead dispatch pipeline (execution.py, docs/pipeline.md).
+
+The contract under test is bit-identity: ``SE_TPU_PIPELINE=0`` pins the
+synchronous pre-pipeline path, and every depth must produce the SAME
+model — same members, same predictions, same early-stop round — because
+member keys/masks derive from absolute round indices and a stop or guard
+recovery discards the speculative in-flight chunks.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import spark_ensemble_tpu as se
+from spark_ensemble_tpu import execution
+from tests.conftest import accuracy
+
+
+def _reg_data(n=900, d=8, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] + np.sin(2.0 * X[:, 1]) + 0.1 * rng.randn(n)).astype(
+        np.float32
+    )
+    return X, y
+
+
+def _clf_data(n=900, d=8, k=4, seed=4):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    centers = rng.randn(k, d).astype(np.float32)
+    y = np.argmax(X @ centers.T, axis=1).astype(np.float32)
+    return X, y
+
+
+def test_depth_resolution_env_wins(monkeypatch):
+    monkeypatch.setenv(execution.PIPELINE_ENV, "2")
+    assert execution.resolve_pipeline_depth() == 2
+    monkeypatch.setenv(execution.PIPELINE_ENV, "0")
+    assert execution.resolve_pipeline_depth() == 0
+    # clamped to [0, MAX_PIPELINE_DEPTH]
+    monkeypatch.setenv(execution.PIPELINE_ENV, "99")
+    assert execution.resolve_pipeline_depth() == execution.MAX_PIPELINE_DEPTH
+    monkeypatch.setenv(execution.PIPELINE_ENV, "-3")
+    assert execution.resolve_pipeline_depth() == 0
+
+
+def test_depth_resolution_invalid_env_falls_back(monkeypatch):
+    monkeypatch.setenv(execution.PIPELINE_ENV, "banana")
+    assert (
+        execution.resolve_pipeline_depth()
+        == execution.DEFAULT_PIPELINE_DEPTH
+    )
+    monkeypatch.delenv(execution.PIPELINE_ENV, raising=False)
+    assert 0 <= execution.resolve_pipeline_depth(1000) <= (
+        execution.MAX_PIPELINE_DEPTH
+    )
+
+
+@pytest.mark.parametrize("depth", [1, 2])
+def test_gbm_regressor_bit_identical_across_depths(monkeypatch, depth):
+    X, y = _reg_data()
+    vi = np.zeros((X.shape[0],), bool)
+    vi[::4] = True
+
+    def run(d):
+        monkeypatch.setenv(execution.PIPELINE_ENV, str(d))
+        return se.GBMRegressor(
+            num_base_learners=10, scan_chunk=3, num_rounds=4
+        ).fit(X, y, validation_indicator=vi)
+
+    sync, piped = run(0), run(depth)
+    assert sync.num_members == piped.num_members
+    assert bool(jnp.array_equal(sync.predict(X), piped.predict(X)))
+
+
+def test_gbm_classifier_bit_identical_and_midchunk_stop(monkeypatch):
+    X, y = _clf_data()
+    vi = np.zeros((X.shape[0],), bool)
+    vi[::4] = True
+    # tight patience + tiny chunks => the validation stop lands mid-run
+    # while speculative chunks are in flight; the pipeline must discard
+    # them and keep exactly the synchronous member count
+    def run(d):
+        monkeypatch.setenv(execution.PIPELINE_ENV, str(d))
+        return se.GBMClassifier(
+            num_base_learners=14, scan_chunk=2, num_rounds=2,
+            learning_rate=1.0,
+        ).fit(X, y, validation_indicator=vi)
+
+    sync, piped = run(0), run(1)
+    assert sync.num_members == piped.num_members
+    assert sync.num_members < 14  # the stop actually fired
+    assert bool(
+        jnp.array_equal(sync.predict_proba(X), piped.predict_proba(X))
+    )
+
+
+def test_gbm_no_validation_bit_identical(monkeypatch):
+    X, y = _reg_data()
+
+    def run(d):
+        monkeypatch.setenv(execution.PIPELINE_ENV, str(d))
+        return se.GBMRegressor(num_base_learners=6, scan_chunk=2).fit(X, y)
+
+    sync, piped = run(0), run(2)
+    assert bool(jnp.array_equal(sync.predict(X), piped.predict(X)))
+
+
+@pytest.mark.parametrize("algorithm", ["discrete", "real"])
+def test_boosting_classifier_bit_identical(monkeypatch, algorithm):
+    X, y = _clf_data()
+
+    def run(d):
+        monkeypatch.setenv(execution.PIPELINE_ENV, str(d))
+        return se.BoostingClassifier(
+            num_base_learners=6, scan_chunk=2, algorithm=algorithm
+        ).fit(X, y)
+
+    sync, piped = run(0), run(1)
+    assert sync.num_members == piped.num_members
+    assert bool(jnp.array_equal(sync.predict_raw(X), piped.predict_raw(X)))
+
+
+def test_boosting_abort_path_bit_identical(monkeypatch):
+    # pure-noise labels make discrete SAMME abort early (err >= 1 - 1/K);
+    # the abort happens during commit while lookahead chunks are already
+    # dispatched — those must be discarded, not appended
+    rng = np.random.RandomState(7)
+    X = rng.randn(600, 6).astype(np.float32)
+    y = rng.randint(0, 5, size=600).astype(np.float32)
+
+    def run(d):
+        monkeypatch.setenv(execution.PIPELINE_ENV, str(d))
+        return se.BoostingClassifier(
+            num_base_learners=8, scan_chunk=4, algorithm="discrete"
+        ).fit(X, y)
+
+    sync, piped = run(0), run(1)
+    assert sync.num_members == piped.num_members
+    if sync.num_members:
+        assert bool(
+            jnp.array_equal(sync.predict_raw(X), piped.predict_raw(X))
+        )
+
+
+def test_boosting_regressor_bit_identical(monkeypatch):
+    X, y = _reg_data()
+
+    def run(d):
+        monkeypatch.setenv(execution.PIPELINE_ENV, str(d))
+        return se.BoostingRegressor(
+            num_base_learners=5, scan_chunk=2
+        ).fit(X, y)
+
+    sync, piped = run(0), run(1)
+    assert sync.num_members == piped.num_members
+    assert np.allclose(
+        np.asarray(sync.predict(X)), np.asarray(piped.predict(X))
+    )
+
+
+def test_device_patience_matches_host(monkeypatch):
+    X, y = _clf_data()
+    vi = np.zeros((X.shape[0],), bool)
+    vi[::4] = True
+
+    def run(dp):
+        monkeypatch.setenv(execution.PIPELINE_ENV, "1")
+        monkeypatch.setenv(execution.DEVICE_PATIENCE_ENV, dp)
+        return se.GBMClassifier(
+            num_base_learners=12, scan_chunk=3, num_rounds=3
+        ).fit(X, y, validation_indicator=vi)
+
+    host, device = run("0"), run("1")
+    assert host.num_members == device.num_members
+    assert bool(
+        jnp.array_equal(host.predict_proba(X), device.predict_proba(X))
+    )
+    assert accuracy(device.predict(X), y) > 0.5
+
+
+def test_host_blocked_metric_emitted(monkeypatch):
+    from spark_ensemble_tpu.telemetry import record_fits
+
+    X, y = _reg_data(n=400)
+    for depth in ("0", "1"):
+        monkeypatch.setenv(execution.PIPELINE_ENV, depth)
+        with record_fits() as rec:
+            se.GBMRegressor(num_base_learners=4, scan_chunk=2).fit(X, y)
+        fit_end = next(
+            e for e in rec.events if e.get("event") == "fit_end"
+        )
+        assert fit_end["host_blocked_us"] >= 0.0
+        assert fit_end["host_blocked_us"] <= fit_end["wall_s"] * 1e6

@@ -477,3 +477,84 @@ def test_fit_aborted_on_validation_error_has_zero_rounds():
     aborted = [e for e in rec.events if e["event"] == "fit_aborted"]
     # validation raises BEFORE telemetry starts: no stream, nothing to abort
     assert aborted == [] or aborted[0]["rounds"] == 0
+
+
+# ---------------------------------------------------------------------------
+# lookahead pipeline x chaos (docs/pipeline.md): speculation must not
+# change what a fault recovery produces
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("policy", ["skip_round", "halve_step", "stop_early"])
+def test_gbm_guard_recovery_identical_across_pipeline(monkeypatch, policy):
+    X, y = _data()
+    results = {}
+    for depth in ("0", "1"):
+        monkeypatch.setenv("SE_TPU_PIPELINE", depth)
+        ctl = _chaos(faults=("nan_grad",), budgets={"nan_grad": 1})
+        m = se.GBMRegressor(
+            num_base_learners=5, scan_chunk=2, on_nonfinite=policy
+        ).fit(X, y)
+        assert ctl.fired
+        chaos.install(None)
+        results[depth] = (m.num_members, np.asarray(m.predict(X)))
+    assert results["0"][0] == results["1"][0]
+    assert np.array_equal(results["0"][1], results["1"][1])
+
+
+def test_boosting_guard_recovery_identical_across_pipeline(monkeypatch):
+    X, y = _cls_data()
+    results = {}
+    for depth in ("0", "1"):
+        monkeypatch.setenv("SE_TPU_PIPELINE", depth)
+        ctl = _chaos(faults=("nan_grad",), budgets={"nan_grad": 1})
+        m = se.BoostingClassifier(
+            num_base_learners=4, scan_chunk=2, algorithm="real",
+            on_nonfinite="skip_round",
+        ).fit(X, y)
+        assert ctl.fired
+        chaos.install(None)
+        results[depth] = (m.num_members, np.asarray(m.predict_proba(X)))
+    assert results["0"][0] == results["1"][0]
+    assert np.array_equal(results["0"][1], results["1"][1])
+
+
+@pytest.mark.parametrize(
+    "make_est",
+    [
+        lambda ckdir: se.GBMRegressor(
+            num_base_learners=6, scan_chunk=2,
+            checkpoint_dir=ckdir, checkpoint_interval=1,
+        ),
+        lambda ckdir: se.BoostingRegressor(
+            num_base_learners=6, scan_chunk=2,
+            checkpoint_dir=ckdir, checkpoint_interval=1,
+        ),
+    ],
+    ids=["gbm", "boosting"],
+)
+def test_pipelined_kill_and_resume_matches_sync(
+    tmp_path, monkeypatch, make_est
+):
+    """Kill-and-resume under the pipeline: the checkpoint written while
+    speculative chunks were in flight must hold only COMMITTED state, so
+    the resumed pipelined fit lands bit-identical to an uninterrupted
+    synchronous fit."""
+    X, y = _data()
+    monkeypatch.setenv("SE_TPU_PIPELINE", "0")
+    p_ref = np.asarray(make_est(None).fit(X, y).predict(X))
+
+    monkeypatch.setenv("SE_TPU_PIPELINE", "1")
+    est = make_est(str(tmp_path / "ck"))
+    _chaos(seed=3, faults=("preempt",), budgets={"preempt": 1})
+    with pytest.raises(ChaosPreemption):
+        est.fit(X, y)
+    chaos.install(None)
+
+    with record_fits() as rec:
+        m = est.fit(X, y)  # resumes from the checkpoint, pipeline on
+    resumes = [
+        e for e in rec.events if e["event"] == "resume_from_checkpoint"
+    ]
+    assert resumes and resumes[0]["round"] >= 1
+    assert np.array_equal(np.asarray(m.predict(X)), p_ref)

@@ -1,0 +1,117 @@
+"""Shared feature binning across a tuning search (tuning.py).
+
+Weight-mask folds fit every (param-map, fold) candidate on the identical
+full ``X``, so the base learner's fit context — feature binning and bin
+assignment, the dominant host-side setup cost — is computed ONCE per
+search and shared.  ``share_binning`` toggles only the memoization, so
+scores must be bit-identical either way.
+"""
+
+import numpy as np
+import pytest
+
+import spark_ensemble_tpu as se
+from spark_ensemble_tpu.evaluation import (
+    MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
+from spark_ensemble_tpu.models.tree import DecisionTreeRegressor
+from spark_ensemble_tpu.tuning import (
+    CrossValidator,
+    ParamGridBuilder,
+    TrainValidationSplit,
+)
+
+
+def _clf_data(n=500, d=8, k=3, seed=11):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    centers = rng.randn(k, d).astype(np.float32)
+    y = np.argmax(X @ centers.T, axis=1).astype(np.float32)
+    return X, y
+
+
+@pytest.fixture
+def ctx_counter(monkeypatch):
+    """Count DecisionTreeRegressor.make_fit_ctx calls (the binning pass of
+    every GBM base fit in these tests)."""
+    calls = {"n": 0}
+    orig = DecisionTreeRegressor.make_fit_ctx
+
+    def counting(self, X, num_classes=None):
+        calls["n"] += 1
+        return orig(self, X, num_classes)
+
+    monkeypatch.setattr(DecisionTreeRegressor, "make_fit_ctx", counting)
+    return calls
+
+
+def test_cv_shared_binning_single_pass_and_identical_scores(ctx_counter):
+    X, y = _clf_data()
+    grid = ParamGridBuilder().add_grid("num_base_learners", [2, 4]).build()
+    ev = MulticlassClassificationEvaluator(metric="accuracy")
+
+    def run(share):
+        ctx_counter["n"] = 0
+        cv = CrossValidator(
+            estimator=se.GBMClassifier(),
+            evaluator=ev,
+            estimator_param_maps=grid,
+            num_folds=3,
+            share_binning=share,
+        )
+        model = cv.fit(X, y)
+        return model, ctx_counter["n"]
+
+    shared, n_shared = run(True)
+    unshared, n_unshared = run(False)
+    # 2 maps x 3 folds + 1 best-map refit = 7 independent binning passes
+    # without sharing; exactly one with
+    assert n_shared == 1
+    assert n_unshared == 2 * 3 + 1
+    assert shared.avg_metrics == unshared.avg_metrics
+    assert shared.fold_metrics == unshared.fold_metrics
+    assert shared.best_index == unshared.best_index
+
+
+def test_tvs_shared_binning_single_pass_and_identical_scores(ctx_counter):
+    rng = np.random.RandomState(5)
+    X = rng.randn(400, 6).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2).astype(np.float32)
+    grid = ParamGridBuilder().add_grid("learning_rate", [0.1, 0.3]).build()
+    ev = RegressionEvaluator(metric="rmse")
+
+    def run(share):
+        ctx_counter["n"] = 0
+        tvs = TrainValidationSplit(
+            estimator=se.GBMRegressor(num_base_learners=3),
+            evaluator=ev,
+            estimator_param_maps=grid,
+            share_binning=share,
+        )
+        model = tvs.fit(X, y)
+        return model, ctx_counter["n"]
+
+    shared, n_shared = run(True)
+    unshared, n_unshared = run(False)
+    assert n_shared == 1
+    assert n_unshared == 2 + 1  # 2 maps + best refit
+    assert shared.validation_metrics == unshared.validation_metrics
+    assert shared.best_index == unshared.best_index
+
+
+def test_cv_with_sample_weights_identical(ctx_counter):
+    X, y = _clf_data(n=360)
+    w = np.random.RandomState(0).uniform(0.5, 2.0, size=X.shape[0])
+    ev = MulticlassClassificationEvaluator(metric="accuracy")
+
+    def run(share):
+        cv = CrossValidator(
+            estimator=se.GBMClassifier(num_base_learners=3),
+            evaluator=ev,
+            num_folds=2,
+            share_binning=share,
+        )
+        return cv.fit(X, y, sample_weight=w)
+
+    assert run(True).avg_metrics == run(False).avg_metrics

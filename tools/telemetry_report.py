@@ -129,6 +129,17 @@ def render_fit(fit_id: str, fit_events: List[dict]) -> str:
             f"compiles: {fit_end.get('compile_count', '?')} "
             f"({float(fit_end.get('compile_s', 0.0)):.3f}s)"
         )
+        blocked_us = fit_end.get("host_blocked_us")
+        if blocked_us is not None:
+            wall_s = float(fit_end.get("wall_s", 0.0))
+            share = (
+                f" ({blocked_us / 1e4 / wall_s:.1f}% of wall)"
+                if wall_s > 0
+                else ""
+            )
+            lines.append(
+                f"host_blocked: {float(blocked_us) / 1e3:.1f}ms{share}"
+            )
         mem = fit_end.get("memory") or {}
         for dev, stats in sorted(mem.items()):
             peak = stats.get("peak_bytes_in_use")
