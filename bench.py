@@ -750,6 +750,82 @@ def inner():
         ),
     }
 
+    # hist-tier A/B (docs/fused_kernel.md): the same round loop with the
+    # histogram backend pinned to 'matmul' vs the bit-packed 'fused' round
+    # kernel, warm programs on both legs.  On CPU the fused kernel runs in
+    # pallas interpret mode, which caps rows (_INTERPRET_MAX_ROWS) — the
+    # leg subsamples under the cap and trims rounds so the A/B stays a
+    # parity/ratio check there; the timed speedup is only meaningful on a
+    # real accelerator.  hbm_bytes_est is static (round_cost_est), so the
+    # modeled traffic ratio — the quantity the fused tier exists to move —
+    # rides along even when the wall-clock legs are CPU noise.
+    from spark_ensemble_tpu.ops.tree import round_cost_est
+
+    platform = jax.devices()[0].platform
+    ab_bins = 16  # packs 4-bit: the headline compression case
+    if platform == "cpu":
+        from spark_ensemble_tpu.ops.pallas_hist import _INTERPRET_MAX_ROWS
+
+        ab_rows = min(X.shape[0], _INTERPRET_MAX_ROWS)
+        ab_rounds = min(num_rounds, 10)
+    else:
+        ab_rows, ab_rounds = X.shape[0], num_rounds
+    Xab, yab = X[:ab_rows], y[:ab_rows]
+
+    def _hist_tier_leg(tier):
+        leg_est = est.copy(
+            num_base_learners=ab_rounds,
+            base_learner=DecisionTreeRegressor(
+                hist=tier, max_bins=ab_bins, hist_precision=hist_precision
+            ),
+        )
+        leg_est.fit(Xab, yab)  # warmup at the timed round count
+        with record_fits() as rec:
+            leg_model, leg_s = _timed_fit(leg_est, Xab, yab)
+        rend = next(
+            (
+                e
+                for e in rec.events
+                if e.get("event") == "round_end" and "hist_tier" in e
+            ),
+            {},
+        )
+        acc = float(np.mean(np.asarray(leg_model.predict(Xab)) == yab))
+        return leg_s, rend, acc
+
+    hist_tier_ab = {}
+    try:
+        mat_s, mat_ev, mat_acc = _hist_tier_leg("matmul")
+        fus_s, fus_ev, fus_acc = _hist_tier_leg("fused")
+        costs = {
+            tier: round_cost_est(
+                ab_rows, X.shape[1], 1, 26, 5, ab_bins, hist=tier
+            )
+            for tier in ("matmul", "fused")
+        }
+        hist_tier_ab = {
+            "fused_speedup": round(mat_s / fus_s, 3),
+            "matmul_fit_seconds": round(mat_s, 3),
+            "fused_fit_seconds": round(fus_s, 3),
+            "resolved_tier": fus_ev.get("hist_tier"),
+            "pack_bits": fus_ev.get("pack_bits"),
+            "mfu_est": fus_ev.get("mfu_est"),
+            "matmul_mfu_est": mat_ev.get("mfu_est"),
+            "hbm_bytes_matmul": costs["matmul"]["hbm_bytes_est"],
+            "hbm_bytes_fused": costs["fused"]["hbm_bytes_est"],
+            "hbm_ratio": round(
+                costs["matmul"]["hbm_bytes_est"]
+                / max(costs["fused"]["hbm_bytes_est"], 1),
+                2,
+            ),
+            "train_accuracy_delta": round(fus_acc - mat_acc, 4),
+            "rows": ab_rows,
+            "rounds": ab_rounds,
+            "max_bins": ab_bins,
+        }
+    except Exception as e:  # noqa: BLE001 - carry, keep going
+        hist_tier_ab = {"error": str(e)[:200]}
+
     # tuned-vs-default (docs/autotune.md): the headline above resolved
     # every tunable through the published tuning cache (when one exists
     # for this device); re-measure the same fit + predict with autotuning
@@ -778,8 +854,6 @@ def inner():
         "default_fit_seconds": round(def_fit_s, 2),
         "default_predict_rows_per_sec": round(X.shape[0] / def_pred_s, 1),
     }
-
-    platform = jax.devices()[0].platform
 
     # emit the HEADLINE result immediately (flushed): the parent takes the
     # LAST parseable stdout line, so if a perishable accelerator window
@@ -814,6 +888,8 @@ def inner():
         "serving_compiles_after_warmup": serving_compiles,
         "pipeline_speedup": pipeline_ab["speedup"],
         "pipeline": pipeline_ab,
+        "fused_speedup": hist_tier_ab.get("fused_speedup"),
+        "hist_tier_ab": hist_tier_ab,
         "autotune": autotune_state,
         "tuned_vs_default": tuned_vs_default,
         "platform": platform,

@@ -355,6 +355,55 @@ def run_search(
             )
             if br != TUNABLES["pallas_block_rows"].default:
                 winners["pallas_block_rows"] = br
+
+            # fused round kernel tiling: one routed level over bit-packed
+            # bins, the shape the fused tier runs every round
+            def fused_thunk():
+                if not real:
+                    return lambda: None
+                from spark_ensemble_tpu.ops.binning import pack_bins, pack_width
+                from spark_ensemble_tpu.ops.pallas_hist import fused_round_level
+
+                bins = min(cfg["bins"], 256)  # fused packs B <= 256 only
+                bits = pack_width(bins)
+                rng = np.random.default_rng(3)
+                cb = pack_bins(
+                    jax.numpy.asarray(
+                        rng.integers(0, bins, size=(n, d), dtype=np.int32)
+                    ),
+                    bins, bits,
+                )
+                node = jax.numpy.asarray(
+                    rng.integers(0, 4, size=(n, 4), dtype=np.int32)
+                )
+                vals = jax.numpy.asarray(
+                    rng.standard_normal((n, 4, 3)).astype(np.float32)
+                )
+                bf = jax.numpy.asarray(
+                    rng.integers(0, d, size=(4, 4), dtype=np.int32)
+                )
+                bt = jax.numpy.asarray(
+                    rng.integers(0, bins, size=(4, 4), dtype=np.int32)
+                )
+
+                def run():
+                    return fused_round_level(
+                        cb.packed, node, vals, bf, bt, n_nodes=8,
+                        max_bins=bins, bits=bits, num_features=d,
+                    )
+
+                return run
+
+            cands = _candidate_rows(
+                list(TUNABLES["fused_block_rows"].candidates),
+                TUNABLES["fused_block_rows"].default,
+            )
+            fbr, _, _ = _sweep(
+                "fused_block_rows", cands, fused_thunk, measure,
+                repeats, timings, real=real,
+            )
+            if fbr != TUNABLES["fused_block_rows"].default:
+                winners["fused_block_rows"] = fbr
         else:
             logger.info("pallas group skipped: platform=%s (TPU only)", platform)
 

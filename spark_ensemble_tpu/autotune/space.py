@@ -104,10 +104,12 @@ TUNABLES = TunableSpace((
         site="ops/tree.py:_PREDICT_FUSED_MAX_CELLS",
     ),
     Tunable(
-        "hist_tier", "auto", ("auto", "scatter", "matmul", "stream"),
+        "hist_tier", "auto",
+        ("auto", "scatter", "matmul", "stream", "fused"),
         doc="histogram accumulation backend consulted when the "
         "estimator's hist param is 'auto' (scatter=segment_sum, "
-        "matmul=dense one-hot MXU path, stream=row-chunked)",
+        "matmul=dense one-hot MXU path, stream=row-chunked, "
+        "fused=bit-packed pallas round kernel)",
         site="ops/tree.py:_resolve_hist",
         kind="choice",
     ),
@@ -122,6 +124,27 @@ TUNABLES = TunableSpace((
         doc="VMEM budget (bytes) for the pallas kernel's resident "
         "accumulator; configs over it fall back to the matmul path",
         site="ops/pallas_hist.py:_VMEM_BUDGET",
+    ),
+    Tunable(
+        "pack_bits", 0, (0, 4, 8, 32),
+        doc="lane width of the fused tier's bit-packed bin matrix "
+        "(0 = auto: the narrowest width max_bins allows; a tuned "
+        "value never narrows below that)",
+        site="ops/binning.py:pack_width",
+        kind="choice",
+    ),
+    Tunable(
+        "fused_block_rows", 256, (128, 256, 512, 1024),
+        doc="rows per grid step of the fused round kernel",
+        site="ops/pallas_hist.py:_FUSED_BLOCK_ROWS",
+    ),
+    Tunable(
+        "fused_vmem_budget", 12 * 2**20,
+        (8 * 2**20, 12 * 2**20, 16 * 2**20, 24 * 2**20),
+        doc="VMEM budget (bytes) for the fused round kernel's resident "
+        "accumulator + routing tables; configs over it fall back to "
+        "the matmul/stream tiers",
+        site="ops/pallas_hist.py:_FUSED_VMEM_BUDGET",
     ),
     Tunable(
         "predict_bucket_pow2_exact", 512, (256, 512, 1024, 2048),

@@ -118,6 +118,25 @@ def concat_pytrees(chunks: List[Any]):
     )
 
 
+def _round_cost(base, n: int, d: int, members: int):
+    """Static per-round cost model for telemetry round events (ops/tree.py
+    ``round_cost_est``): resolved histogram tier, packed-lane width, HBM
+    bytes and MXU flops per round.  ``members`` is the number of trees a
+    round fits (1 for the regressor, the class dim for the classifier).
+    None when the base learner is not a histogram tree."""
+    try:
+        from spark_ensemble_tpu.ops.tree import round_cost_est
+
+        return round_cost_est(
+            n=int(n), d=int(d), k=1, M=int(members),
+            max_depth=int(base.max_depth), max_bins=int(base.max_bins),
+            hist=str(getattr(base, "hist", "auto")),
+            hist_precision=str(getattr(base, "hist_precision", "highest")),
+        )
+    except (AttributeError, TypeError, ValueError):
+        return None
+
+
 class _GBMParams(CheckpointableParams, Estimator):
     """Shared GBM params (reference `GBMParams.scala:29-137` defaults)."""
 
@@ -303,6 +322,7 @@ class _GBMParams(CheckpointableParams, Estimator):
         snapshot=None,  # () -> opaque copy of the carried prediction state
         restore=None,  # (snap) -> None; rewind the carry to chunk start
         n_rows: Optional[int] = None,  # training rows (autotune shape class)
+        round_cost=None,  # ops.tree.round_cost_est dict for telemetry
     ):
         """The shared round-loop driver: scan-chunked dispatch (one program
         per `scan_chunk` rounds, single-chip AND under a mesh — validation
@@ -360,6 +380,7 @@ class _GBMParams(CheckpointableParams, Estimator):
                     i, c, t_chunk,
                     fence=(params_c, weights_c, errs),
                     losses=errs, step_sizes=weights_c,
+                    round_cost=round_cost,
                 )
             members_chunks.append(params_c)
             weights_chunks.append(weights_c)
@@ -1226,6 +1247,7 @@ class GBMRegressor(_GBMParams):
             val_history=val_history, telem=telem,
             guard=self._numeric_guard(telem),
             snapshot=snapshot, restore=restore, n_rows=n,
+            round_cost=_round_cost(base, n, d, 1),
         )
         ckpt.delete()
 
@@ -1825,6 +1847,7 @@ class GBMClassifier(_GBMParams):
             val_history=val_history, telem=telem,
             guard=self._numeric_guard(telem),
             snapshot=snapshot, restore=restore, n_rows=n,
+            round_cost=_round_cost(base, n, d, dim),
         )
         ckpt.delete()
 

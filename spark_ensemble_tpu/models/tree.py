@@ -71,14 +71,19 @@ class _TreeLearner(BaseLearner):
     )
     hist = Param(
         "auto",
-        in_array(["auto", "scatter", "matmul", "stream"]),
+        in_array(["auto", "scatter", "matmul", "stream", "fused"]),
         doc="Histogram accumulation backend (ops/tree.py): 'auto' picks "
         "the one-hot matmul on accelerators (MXU path), segment_sum "
         "scatter-adds on CPU, and the row-chunked 'stream' tier when the "
         "matmul's [n, d*bins] one-hot outgrows its budget; 'stream' "
         "forces the chunked tier — the HBM-scale path (>~1M rows) whose "
         "per-level traffic is one read of the compact binned features "
-        "instead of materialized full-n one-hots.",
+        "instead of materialized full-n one-hots; 'fused' runs each tree "
+        "level as ONE pallas kernel over bit-packed 4/8-bit bins "
+        "(docs/fused_kernel.md): 4-8x less HBM on the dominant read, "
+        "in-kernel routing, 3-term bf16 histogram statistics (f32-grade; "
+        "predictions tight-allclose to 'matmul'; max_bins <= 256, falls "
+        "back to matmul/stream over the VMEM budget or off-TPU at scale).",
     )
     seed = Param(0, doc="unused by the deterministic kernels; API parity")
 

@@ -18,6 +18,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from spark_ensemble_tpu.autotune.resolve import resolve as _tuned
+
 
 class Bins(NamedTuple):
     """Per-feature split thresholds; ``thresholds[f, i]`` ascending in i."""
@@ -49,3 +51,86 @@ def bin_features(X: jax.Array, bins: Bins) -> jax.Array:
     return jax.vmap(per_feature, in_axes=(1, 0), out_axes=1)(
         X.astype(jnp.float32), bins.thresholds
     )
+
+
+# ---------------------------------------------------------------------------
+# Compressed (bit-packed) bin storage for the fused round kernel
+# ---------------------------------------------------------------------------
+#
+# Bin ids are tiny integers (< max_bins <= 256), yet the i32 bin matrix
+# spends 32 bits per id — at letter scale the per-level re-read of ``Xb``
+# is the round loop's dominant HBM operand.  ELLPACK-style compressed bin
+# storage (XGBoost GPU, arXiv:1806.11248) packs ids into the narrowest
+# lane that holds ``max_bins`` values: 4-bit lanes for max_bins <= 16,
+# 8-bit for <= 256 — a 4-8x cut of that read.  Layout is LANE-MAJOR:
+# word ``w`` of a row packs features ``l*W + w`` for lane ``l`` (W words
+# per row), so the in-kernel unpack is ``lanes`` shift-and-mask passes
+# each producing a CONTIGUOUS feature block — no minor-dim shuffles on
+# the TPU vector unit.
+
+
+class CompressedBins(NamedTuple):
+    """Bit-packed bin matrix: ``packed[r, w]`` holds ``32 // bits`` ids.
+
+    Plain metadata ints ride along for host-side use; jitted consumers
+    (the fused kernel path) treat ``bits`` / ``num_features`` as static
+    and read only ``packed``.
+    """
+
+    packed: jax.Array  # u32[n, W], W = ceil(d / (32 // bits))
+    bits: int  # lane width: 4, 8, or 32 (32 = unpacked passthrough)
+    num_features: int  # d before padding
+
+    @property
+    def lanes(self) -> int:
+        return 32 // self.bits
+
+    @property
+    def words_per_row(self) -> int:
+        return self.packed.shape[1]
+
+
+def pack_width(max_bins: int) -> int:
+    """Lane width (bits) for ``max_bins`` bin ids: the narrowest of
+    {4, 8} that holds ``max_bins`` values, or 32 (no packing) past 256.
+    A measured winner (autotune: "pack_bits"; 0 = auto) overrides the
+    choice but never below what ``max_bins`` needs."""
+    auto = 4 if max_bins <= 16 else (8 if max_bins <= 256 else 32)
+    tuned = int(_tuned("pack_bits", 0))
+    if tuned in (4, 8, 32) and tuned >= auto:
+        return tuned
+    return auto
+
+
+def pack_bins(Xb: jax.Array, max_bins: int, bits: int = 0) -> CompressedBins:
+    """Pack ``Xb i32[n, d]`` (ids in [0, max_bins)) into ``bits``-bit
+    lanes of u32 words; ``bits=0`` resolves via :func:`pack_width`.
+    Trailing pad features pack as id 0 and are sliced off on unpack."""
+    n, d = Xb.shape
+    bits = bits or pack_width(max_bins)
+    if bits >= 32:
+        return CompressedBins(
+            packed=Xb.astype(jnp.uint32), bits=32, num_features=d
+        )
+    lanes = 32 // bits
+    W = -(-d // lanes)
+    X = jnp.pad(Xb.astype(jnp.uint32), ((0, 0), (0, W * lanes - d)))
+    # lane-major: lane l carries the contiguous feature block [l*W, (l+1)*W)
+    X = X.reshape(n, lanes, W)
+    words = jnp.zeros((n, W), jnp.uint32)
+    for lane in range(lanes):
+        words = words | (X[:, lane, :] << jnp.uint32(lane * bits))
+    return CompressedBins(packed=words, bits=bits, num_features=d)
+
+
+def unpack_bins(cb: CompressedBins) -> jax.Array:
+    """Inverse of :func:`pack_bins`: ``i32[n, d]`` bin ids."""
+    if cb.bits >= 32:
+        return cb.packed.astype(jnp.int32)
+    mask = jnp.uint32(2**cb.bits - 1)
+    blocks = [
+        (cb.packed >> jnp.uint32(lane * cb.bits)) & mask
+        for lane in range(cb.lanes)
+    ]
+    full = jnp.concatenate(blocks, axis=1)
+    return full[:, : cb.num_features].astype(jnp.int32)

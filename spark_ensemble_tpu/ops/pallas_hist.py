@@ -81,12 +81,20 @@ def hist_vmem_bytes(
     n_nodes: int, M: int, C: int, d: int, B: int, blk: int = 0
 ) -> int:
     """Static VMEM estimate for the accumulator + block operands;
-    ``blk`` defaults to the resolved grid-step row count."""
+    ``blk`` defaults to the resolved grid-step row count.
+
+    Counts the i32 bin-iota/compare scratch the one-hot build
+    materializes BEFORE the bf16 cast (``[blk, d, B]`` i32) — an
+    earlier version omitted it, so the fallback decision in
+    ``ops/tree.py`` (which consults this estimate) and the kernel's
+    real footprint could disagree for large ``M*C``.
+    """
     blk = blk or block_rows()
     acc = M * n_nodes * C * d * B * 4
     rhs = blk * d * B * 2
+    unpack_scratch = blk * d * B * 4
     lhs = blk * M * n_nodes * C * (4 + 2 + 2)
-    return acc + rhs + lhs
+    return acc + rhs + unpack_scratch + lhs
 
 
 def _hist_kernel(xb_ref, node_ref, vals_ref, out_ref, *, n_nodes, B):
@@ -183,3 +191,247 @@ def _hist_level_pallas(Xb, node, vals, *, n_nodes, max_bins, blk):
         interpret=_interpret(),
     )(Xb, node, vals)
     return out.reshape(M, n_nodes, C, d, B)
+
+
+# ---------------------------------------------------------------------------
+# Fused round kernel over bit-packed bins (hist="fused")
+# ---------------------------------------------------------------------------
+#
+# The histogram kernel above still reads the bin matrix as i32 — 32 bits
+# per id that is < max_bins.  The fused tier reads the ELLPACK-style
+# packed words from ops/binning.py instead (4-8x less HBM on the round
+# loop's dominant operand) and additionally folds the LEVEL ROUTING into
+# the same grid step: each step DMAs the packed block once, unpacks it
+# with shift-and-mask passes in VMEM, routes the block's rows through the
+# previous level's split tables, builds both one-hots, and accumulates
+# the level histogram — so one pallas program per level replaces the
+# separate route + one-hot + A-build + histogram dispatch chain, and the
+# split-scan / leaf-solve between kernels stay on-device inside the same
+# jitted program (ops/tree.py::_fit_forest_fused).
+#
+# Routing identity: a row goes left iff its bin at the node's split
+# feature is <= the split bin.  The kernel derives that bit from the bin
+# one-hot it already built — ``rhs @ T^T`` where ``T[m*p, f*B+b] =
+# 1[f == best_f[m,p] and b <= best_t[m,p]]`` — every operand is exact 0/1
+# in bf16 and each row dots to exactly 0.0 or 1.0, so routing is
+# bit-identical to ops/tree.py::_route_members for max_bins <= 256 (the
+# packable range).  Histogram precision is the hi/lo two-pass of the
+# kernel above (~16-bit statistic mantissa); leaf sums accumulate in f32.
+
+_FUSED_BLOCK_ROWS = 256
+
+_FUSED_VMEM_BUDGET = 12 * 2**20
+
+
+def fused_block_rows() -> int:
+    """Rows per grid step of the fused round kernel (tuned, live-default
+    like ``block_rows``)."""
+    return int(_tuned("fused_block_rows", _FUSED_BLOCK_ROWS))
+
+
+def fused_vmem_budget() -> int:
+    """Fused-kernel VMEM budget in bytes (tuned, live-default)."""
+    return int(_tuned("fused_vmem_budget", _FUSED_VMEM_BUDGET))
+
+
+def fused_vmem_bytes(
+    n_nodes: int, M: int, C: int, d: int, B: int, bits: int, blk: int = 0
+) -> int:
+    """Static VMEM estimate for the fused kernel's deepest level: the
+    resident accumulator, the unpack/one-hot scratch, the 3-term bf16
+    statistic operands, and the routing tables.  Consulted by
+    ``_resolve_hist`` (ops/tree.py) — configs over
+    :func:`fused_vmem_budget` fall back to the matmul/stream tiers."""
+    blk = blk or fused_block_rows()
+    lanes = max(32 // max(bits, 1), 1)
+    half = max(n_nodes // 2, 1)
+    acc = M * n_nodes * C * d * B * 4
+    packed = blk * (-(-d // lanes)) * 4
+    xb = blk * d * 4
+    unpack_scratch = blk * d * B * 4
+    rhs = blk * d * B * 2
+    lhs = blk * M * n_nodes * C * (4 + 2 + 2 + 2)
+    route = M * half * d * B * (4 + 2) + blk * M * half * (4 + 4)
+    return acc + packed + xb + unpack_scratch + rhs + lhs + route
+
+
+def _fused_kernel(
+    packed_ref, node_ref, vals_ref, bf_ref, bt_ref, hist_ref, node_ref_out,
+    *, n_nodes, B, bits, d, route, leaf,
+):
+    """One grid step of the fused round: unpack + route + accumulate.
+
+    VMEM blocks: packed u32[blk, W], node i32[blk, M] (PARENT-level ids
+    when ``route``), vals f32[blk, M, C], bf/bt i32[M, half] the previous
+    level's split tables.  Outputs: hist f32[M*n_nodes*C, d*B] (or
+    [M*n_nodes*C, 1] column sums when ``leaf``), revisited every step;
+    node_out i32[blk, M] this level's routed ids.
+    """
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        hist_ref[:] = jnp.zeros_like(hist_ref)
+
+    packed = packed_ref[:]
+    node = node_ref[:]
+    vals = vals_ref[:]
+    blk = node.shape[0]
+    _, M, C = vals.shape
+
+    # shift-and-mask unpack: lane l holds the contiguous feature block
+    # [l*W, (l+1)*W) (ops/binning.py lane-major layout), so each pass
+    # yields whole columns and the concat is lane-aligned
+    if bits >= 32:
+        xb = packed.astype(jnp.int32)[:, :d]
+    else:
+        lanes = 32 // bits
+        mask = jnp.uint32(2**bits - 1)
+        blocks = [
+            (packed >> jnp.uint32(lane * bits)) & mask
+            for lane in range(lanes)
+        ]
+        xb = jnp.concatenate(blocks, axis=1)[:, :d].astype(jnp.int32)
+
+    # row-to-bin one-hot (exact 0/1 bf16): the histogram RHS, and the
+    # operand the routing bit is contracted out of
+    bins = jax.lax.broadcasted_iota(jnp.int32, (blk, d, B), 2)
+    rhs = (xb[:, :, None] == bins).astype(jnp.bfloat16).reshape(blk, d * B)
+
+    if route:
+        bf = bf_ref[:]
+        bt = bt_ref[:]
+        half = bf.shape[1]
+        # T[m*p, f*B+b] = 1[f == best_f[m,p] and b <= best_t[m,p]]
+        f_iota = jax.lax.broadcasted_iota(jnp.int32, (M, half, d, B), 2)
+        b_iota = jax.lax.broadcasted_iota(jnp.int32, (M, half, d, B), 3)
+        T = (
+            (f_iota == bf[:, :, None, None])
+            & (b_iota <= bt[:, :, None, None])
+        ).astype(jnp.bfloat16).reshape(M * half, d * B)
+        # U[r, m*p] == 1.0 iff row r's bin at best_f[m,p] <= best_t[m,p]
+        U = jax.lax.dot_general(
+            rhs, T, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).reshape(blk, M, half)
+        parents = jax.lax.broadcasted_iota(jnp.int32, (blk, M, half), 2)
+        poh = (node[:, :, None] == parents).astype(jnp.float32)
+        go_left = jnp.sum(poh * U, axis=2)  # exactly 0.0 or 1.0
+        node = 2 * node + 1 - go_left.astype(jnp.int32)
+    node_ref_out[:] = node
+
+    nodes_iota = jax.lax.broadcasted_iota(jnp.int32, (blk, M, n_nodes), 2)
+    noh = (node[:, :, None] == nodes_iota).astype(jnp.float32)
+    lhs = (noh[:, :, :, None] * vals[:, :, None, :]).reshape(
+        blk, M * n_nodes * C
+    )
+    if leaf:
+        # leaf statistics need no bin axis: f32 column sums, exact
+        # per-block accumulation
+        hist_ref[:] += jnp.sum(lhs, axis=0)[:, None]
+    else:
+        # 3-term bf16 split of the statistic operand (~24-bit mantissa,
+        # f32-grade): hi + lo covers 16 bits, the residual term the rest.
+        # The rhs one-hot is exact in bf16, so the dots' only rounding is
+        # this split — split scores land within f32 tie-break distance of
+        # the dense 'highest' tier (test_fused_gbm_letter_leg_parity).
+        hi = lhs.astype(jnp.bfloat16)
+        lo = (lhs - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        lo2 = (
+            lhs - hi.astype(jnp.float32) - lo.astype(jnp.float32)
+        ).astype(jnp.bfloat16)
+        contract = (((0,), (0,)), ((), ()))
+        acc = jax.lax.dot_general(
+            hi, rhs, contract, preferred_element_type=jnp.float32
+        )
+        acc = acc + jax.lax.dot_general(
+            lo, rhs, contract, preferred_element_type=jnp.float32
+        )
+        acc = acc + jax.lax.dot_general(
+            lo2, rhs, contract, preferred_element_type=jnp.float32
+        )
+        hist_ref[:] += acc
+
+
+def fused_round_level(
+    packed, node, vals, best_f=None, best_t=None, *,
+    n_nodes: int, max_bins: int, bits: int, num_features: int,
+    leaf: bool = False,
+):
+    """One fused level: histogram ``H f32[M, n_nodes, C, d, B]`` (or leaf
+    sums ``[M, n_nodes, C]`` when ``leaf``) plus the routed node ids
+    ``i32[n, M]``.
+
+    ``packed u32[n, W]`` bit-packed bins (ops/binning.py); ``node`` the
+    PREVIOUS level's ids when split tables ``best_f/best_t i32[M, half]``
+    are given (routing is deferred into this kernel, like the stream
+    tier), else this level's ids.  Zero-weight (padding) rows contribute
+    exactly 0.  Block size resolves through ``fused_block_rows()`` at
+    trace time and enters as a static arg (see ``hist_level_pallas``).
+    """
+    M = node.shape[1]
+    route = best_f is not None
+    if not route:
+        best_f = jnp.zeros((M, 1), jnp.int32)
+        best_t = jnp.zeros((M, 1), jnp.int32)
+    return _fused_round_level(
+        packed, node, vals, best_f, best_t, n_nodes=n_nodes,
+        max_bins=max_bins, bits=bits, num_features=num_features,
+        leaf=leaf, route=route, blk=fused_block_rows(),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_nodes", "max_bins", "bits", "num_features", "leaf", "route",
+        "blk",
+    ),
+)
+def _fused_round_level(
+    packed, node, vals, best_f, best_t, *, n_nodes, max_bins, bits,
+    num_features, leaf, route, blk,
+):
+    n, W = packed.shape
+    _, M, C = vals.shape
+    B = max_bins
+    d = num_features
+    half = best_f.shape[1]
+
+    pad = (-n) % blk
+    if pad:
+        # padded rows: vals 0 -> zero contribution regardless of node/bin
+        packed = jnp.pad(packed, ((0, pad), (0, 0)))
+        node = jnp.pad(node, ((0, pad), (0, 0)))
+        vals = jnp.pad(vals, ((0, pad), (0, 0), (0, 0)))
+    steps = (n + pad) // blk
+
+    out_w = 1 if leaf else d * B
+    kernel = functools.partial(
+        _fused_kernel, n_nodes=n_nodes, B=B, bits=bits, d=d, route=route,
+        leaf=leaf,
+    )
+    hist, node_out = pl.pallas_call(
+        kernel,
+        grid=(steps,),
+        in_specs=[
+            pl.BlockSpec((blk, W), lambda i: (i, 0)),
+            pl.BlockSpec((blk, M), lambda i: (i, 0)),
+            pl.BlockSpec((blk, M, C), lambda i: (i, 0, 0)),
+            pl.BlockSpec((M, half), lambda i: (0, 0)),
+            pl.BlockSpec((M, half), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            # the accumulator stays VMEM-resident across the grid; the
+            # routed ids stream out block by block
+            pl.BlockSpec((M * n_nodes * C, out_w), lambda i: (0, 0)),
+            pl.BlockSpec((blk, M), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((M * n_nodes * C, out_w), jnp.float32),
+            jax.ShapeDtypeStruct((n + pad, M), jnp.int32),
+        ],
+        interpret=_interpret(),
+    )(packed, node, vals, best_f, best_t)
+    shape = (M, n_nodes, C) if leaf else (M, n_nodes, C, d, B)
+    return hist.reshape(shape), node_out[:n]

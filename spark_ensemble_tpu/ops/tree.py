@@ -145,9 +145,10 @@ def _prefix_sums(hist_w, hist_wy, bins_axis_w, stat_prec, hist):
     a sequential scan, attacking the per-level cumsum tail in the round
     profile.  The tier policy lives HERE, next to the code it selects.
     The stream tier's histograms are the same matmul statistics (chunk-
-    accumulated), so its fast tiers take the same triangular form."""
+    accumulated), so its fast tiers take the same triangular form — and
+    the fused tier's (kernel-accumulated) likewise."""
     fast_tier = (
-        hist in ("matmul", "stream")
+        hist in ("matmul", "stream", "fused")
         and stat_prec != jax.lax.Precision.HIGHEST
     )
     if not fast_tier:
@@ -252,25 +253,83 @@ def _stat_precision_vs_onehot(stat_prec):
     return (stat_prec, jax.lax.Precision.DEFAULT)
 
 
-def _resolve_hist(hist: str, n: int, d: int, B: int) -> str:
+def _auto_hist_heuristic(n: int, d: int, B: int) -> str:
+    """Static tier heuristic behind hist='auto' (also the fused tier's
+    fallback): every accelerator backend (tpu, tpu-like plugins, gpu)
+    serializes scatter-adds, so only CPU prefers the segment_sum path;
+    past the matmul tier's one-hot budget an accelerator takes the
+    row-chunked STREAM tier (same matmuls, no [n, d*B] operand)."""
+    if jax.default_backend() != "cpu":
+        if n * d * B <= _MATMUL_HIST_MAX_CELLS:
+            return "matmul"
+        return "stream"
+    return "scatter"
+
+
+def _resolve_fused(
+    n: int, d: int, B: int, *, M: int, C: int, max_depth: int,
+    warn: bool = True,
+) -> str:
+    """Gate for the fused round kernel (hist='fused'): confirm the tier
+    or fall back.  The decision consults the SAME static VMEM estimate
+    (``fused_vmem_bytes``) the kernel's footprint is modeled by, so the
+    fallback decision and the estimate cannot disagree."""
+    from spark_ensemble_tpu.ops.binning import pack_width
+    from spark_ensemble_tpu.ops.pallas_hist import (
+        _INTERPRET_MAX_ROWS,
+        _interpret,
+        fused_vmem_budget,
+        fused_vmem_bytes,
+    )
+
+    reason = None
+    if B > _ROUTING_EXACT_MAX_BINS:
+        # 8-bit lanes top out at 256 bins, and past that the in-kernel
+        # routing contraction also loses its bf16 exactness proof
+        reason = f"max_bins={B} exceeds the packable range (256)"
+    elif _interpret() and n > _INTERPRET_MAX_ROWS:
+        reason = (
+            f"no TPU backend at n={n} rows (interpreter mode is viable "
+            f"only below {_INTERPRET_MAX_ROWS})"
+        )
+    else:
+        bits = pack_width(B)
+        need = fused_vmem_bytes(2 ** max(max_depth - 1, 0), M, C, d, B, bits)
+        if need > fused_vmem_budget():
+            reason = (
+                f"VMEM estimate {need} bytes exceeds the "
+                f"{fused_vmem_budget()}-byte budget"
+            )
+    if reason is None:
+        return "fused"
+    fallback = _auto_hist_heuristic(n, d, B)
+    if warn:
+        warnings.warn(
+            f"hist='fused' falling back to the '{fallback}' tier: {reason}",
+            stacklevel=3,
+        )
+    return fallback
+
+
+def _resolve_hist(
+    hist: str, n: int, d: int, B: int, *, M: int = 1, C: int = 2,
+    max_depth: int = 5, warn: bool = True,
+) -> str:
+    if hist == "fused":
+        return _resolve_fused(n, d, B, M=M, C=C, max_depth=max_depth,
+                              warn=warn)
     if hist != "auto":
         return hist
     # a measured winner for this device/shape class overrides the static
     # heuristic below (autotune.resolve; "auto" == no winner recorded).
     # An explicit hist param never reaches this branch — hand-set wins.
     tier = _tuned("hist_tier", "auto", n=n)
+    if tier == "fused":
+        return _resolve_fused(n, d, B, M=M, C=C, max_depth=max_depth,
+                              warn=warn)
     if tier in ("scatter", "matmul", "stream"):
         return tier
-    # every accelerator backend (tpu, tpu-like plugins, gpu) serializes
-    # scatter-adds; only CPU prefers the segment_sum path.  Past the
-    # matmul tier's one-hot budget an accelerator takes the row-chunked
-    # STREAM tier (same matmuls, no [n, d*B] operand) instead of the
-    # serializing scatter path.
-    if jax.default_backend() != "cpu":
-        if n * d * B <= _MATMUL_HIST_MAX_CELLS:
-            return "matmul"
-        return "stream"
-    return "scatter"
+    return _auto_hist_heuristic(n, d, B)
 
 
 @functools.partial(
@@ -291,7 +350,7 @@ def fit_tree(
     max_bins: int = 64,
     min_info_gain: float = 0.0,
     axis_name: Optional[str] = None,
-    hist: str = "auto",  # auto | scatter | matmul | stream
+    hist: str = "auto",  # auto | scatter | matmul | stream | fused
     hist_precision: str = "highest",  # statistic-matmul MXU passes, see below
     return_leaf: bool = False,  # also return each row's final leaf id [n]
 ) -> Tree:
@@ -312,10 +371,10 @@ def fit_tree(
     k = Y.shape[1]
     B = max_bins
     num_internal = 2**max_depth - 1
-    hist = _resolve_hist(hist, n, d, B)
-    if hist == "stream":
-        # the row-chunked tier lives in the fused-forest path; a single
-        # tree is its M=1 case
+    hist = _resolve_hist(hist, n, d, B, M=1, C=1 + k, max_depth=max_depth)
+    if hist in ("stream", "fused"):
+        # the row-chunked and fused-kernel tiers live in the forest
+        # path; a single tree is their M=1 case
         forest = fit_forest(
             Xb,
             Y[:, None, :],
@@ -326,7 +385,7 @@ def fit_tree(
             max_bins=max_bins,
             min_info_gain=min_info_gain,
             axis_name=axis_name,
-            hist="stream",
+            hist=hist,
             hist_precision=hist_precision,
             return_leaf=return_leaf,
         )
@@ -792,6 +851,226 @@ def _fit_forest_streamed(
     return tree
 
 
+def _fit_forest_fused(
+    Xb, Y, w, thresholds, feature_mask, *, max_depth, max_bins,
+    min_info_gain, axis_name, stat_prec, return_leaf=False,
+):
+    """Fused-round tier (``hist="fused"``): bit-packed bins, one pallas
+    program per level.
+
+    The bin matrix is packed ONCE per fit into 4/8-bit lanes
+    (ops/binning.py `CompressedBins`) and every level's kernel DMAs the
+    packed words — a 4-8x cut of the round loop's dominant HBM read
+    versus the i32 matrix the pallas histogram tier streams, and ~B*4x
+    versus the dense matmul tier's ``[n, d*B]`` bin one-hot.  Each grid
+    step unpacks its block in VMEM, routes the rows through the PREVIOUS
+    level's split tables (deferred routing, like the stream tier — but
+    inside the kernel, contracted from the bin one-hot it already built),
+    and accumulates this level's histogram, so a tree level is one kernel
+    dispatch; split scoring and leaf solving stay on-device between
+    kernels inside the same jitted program.
+
+    Precision contract: histograms accumulate as the kernel's 3-term bf16
+    split (~24-bit statistic mantissa — f32-grade, so split scores land
+    within tie-break distance of the dense 'highest' tier); routing is
+    bit-exact (0/1 contractions, max_bins <= 256 is enforced at
+    resolution); leaf sums accumulate in f32.  Split scoring
+    downstream of the histograms follows ``hist_precision`` exactly like
+    the other tiers (`_prefix_sums`).  Every level is computed directly —
+    empty nodes dot to exactly 0.0 — so the exact-path node floors apply,
+    not the subtraction machinery.
+    """
+    from spark_ensemble_tpu.ops.binning import pack_bins, pack_width
+    from spark_ensemble_tpu.ops.pallas_hist import fused_round_level
+
+    n, d = Xb.shape
+    _, M, k = Y.shape
+    B = max_bins
+    num_internal = 2**max_depth - 1
+    preduce = lambda x: _preduce(x, axis_name)
+
+    w = w.astype(jnp.float32)
+    w_tot = preduce(jnp.sum(w, axis=0))  # [M]
+    y_mean = preduce(jnp.sum(w[:, :, None] * Y, axis=0)) / jnp.maximum(
+        w_tot[:, None], 1e-30
+    )  # [M, k]
+    vals = jnp.concatenate(
+        [w[:, :, None], w[:, :, None] * (Y - y_mean[None, :, :])], axis=2
+    )  # [n, M, 1+k]
+
+    bits = pack_width(B)
+    # loop-invariant: packed once, read by every level's kernel
+    cb = pack_bins(Xb, B, bits)
+
+    split_feature = jnp.zeros((M, num_internal), jnp.int32)
+    split_bin = jnp.zeros((M, num_internal), jnp.int32)
+    split_threshold = jnp.zeros((M, num_internal), jnp.float32)
+    split_gain = jnp.zeros((M, num_internal), jnp.float32)
+    node = jnp.zeros((n, M), jnp.int32)
+    parent_value = y_mean[:, None, :]  # [M, 1, k]
+    prev_tables = (None, None)  # previous level's (best_f, best_t)
+
+    for level in range(max_depth):
+        n_nodes = 2**level
+        H, node = fused_round_level(
+            cb.packed, node, vals, prev_tables[0], prev_tables[1],
+            n_nodes=n_nodes, max_bins=B, bits=bits, num_features=d,
+        )
+        H = preduce(H)
+
+        node_floor = jnp.full((M, n_nodes), 1e-12, jnp.float32)
+        best_f, best_t, thr, do_split, best_gain, node_w, node_wy = (
+            _level_split_tables(
+                H, feature_mask, node_floor, min_info_gain, thresholds, B,
+                stat_prec, "fused",
+            )
+        )
+
+        heap = (2**level - 1) + jnp.arange(n_nodes)
+        split_feature = split_feature.at[:, heap].set(best_f)
+        split_bin = split_bin.at[:, heap].set(best_t)
+        split_threshold = split_threshold.at[:, heap].set(thr)
+        split_gain = split_gain.at[:, heap].set(
+            jnp.where(do_split, best_gain, 0.0)
+        )
+
+        node_val = node_wy / jnp.maximum(node_w[:, :, None], 1e-30)
+        node_val = jnp.where(
+            node_w[:, :, None] > node_floor[:, :, None], node_val,
+            parent_value,
+        )
+        parent_value = jnp.repeat(node_val, 2, axis=1)
+        prev_tables = (best_f, best_t)
+
+    # final kernel: route the last level, accumulate leaf sums (no bin
+    # axis — the kernel's leaf mode outputs f32 column sums)
+    num_leaves = 2**max_depth
+    L, node = fused_round_level(
+        cb.packed, node, vals, prev_tables[0], prev_tables[1],
+        n_nodes=num_leaves, max_bins=B, bits=bits, num_features=d,
+        leaf=True,
+    )
+    leaf_w = preduce(L[:, :, 0])  # [M, L]
+    leaf_wy = preduce(L[:, :, 1:])  # [M, L, k]
+    leaf_value = leaf_wy / jnp.maximum(leaf_w[:, :, None], 1e-30)
+    leaf_value = jnp.where(
+        leaf_w[:, :, None] > 1e-12, leaf_value, parent_value
+    )
+    tree = Tree(
+        split_feature=split_feature,
+        split_bin=split_bin,
+        split_threshold=split_threshold,
+        leaf_value=leaf_value + y_mean[:, None, :],
+        split_gain=split_gain,
+    )
+    return (tree, node) if return_leaf else tree
+
+
+def resolved_forest_tier(
+    hist: str, hist_precision: str, n: int, d: int, B: int, *,
+    M: int = 1, C: int = 2, max_depth: int = 5,
+) -> str:
+    """The histogram tier ``fit_forest`` would actually run for these
+    static shapes: ``"pallas"`` when the pallas histogram kernel hosts
+    the matmul tier, else the resolved hist string (fallbacks applied).
+    Pure and warning-free — telemetry and bench call it to label rounds
+    without side effects."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        pallas_tier = hist_precision.lower() == "pallas" and hist != "fused"
+        if pallas_tier and hist == "auto":
+            hist = (
+                "matmul" if n * d * B <= _MATMUL_HIST_MAX_CELLS else "stream"
+            )
+        elif not (pallas_tier and hist == "matmul"):
+            hist = _resolve_hist(
+                hist, n, d, B, M=M, C=C, max_depth=max_depth, warn=False
+            )
+        pallas_tier = pallas_tier and hist == "matmul"
+        if pallas_tier:
+            from spark_ensemble_tpu.ops.pallas_hist import (
+                _INTERPRET_MAX_ROWS,
+                _interpret,
+                hist_vmem_bytes,
+                vmem_budget,
+            )
+
+            if _interpret() and n > _INTERPRET_MAX_ROWS:
+                pallas_tier = False
+            elif (
+                hist_vmem_bytes(2 ** max(max_depth - 1, 0), M, C, d, B)
+                > vmem_budget()
+            ):
+                pallas_tier = False
+        return "pallas" if pallas_tier else hist
+
+
+def round_cost_est(
+    n: int, d: int, k: int, M: int, max_depth: int, max_bins: int,
+    hist: str = "auto", hist_precision: str = "highest",
+) -> dict:
+    """Static per-round cost estimate from shapes + the resolved tier.
+
+    Returns ``{"hist_tier", "pack_bits", "hbm_bytes_est", "flops_est",
+    "peak_flops"}``.  ``hbm_bytes_est`` models each tier's dominant HBM
+    reads of row-sized operands summed over the tree's levels plus the
+    leaf pass — write traffic and O(nodes*bins) tables are negligible at
+    n >> nodes.  The matmul tier's per-level cost grows with the node
+    count: it materializes the ``[n, M*nodes*C]`` node-stat operand each
+    level and the ``[n, M, leaves]`` leaf one-hot (fit_forest), both
+    full-row HBM intermediates; the stream/pallas/fused tiers build
+    their one-hots per block in VMEM, so their per-level reads are flat.
+    ``flops_est`` is the histogram-contraction MAC count (2 flops each),
+    identical across tiers, so ``mfu_est = flops_est / (round_seconds *
+    peak_flops)`` is comparable between tiers.  Feeds FitTelemetry round
+    events (models/gbm.py) and the bench hist-tier A/B leg.
+    """
+    B = max_bins
+    C = 1 + k
+    tier = resolved_forest_tier(
+        hist, hist_precision, n, d, B, M=M, C=C, max_depth=max_depth
+    )
+    from spark_ensemble_tpu.ops.binning import pack_width
+
+    bits = pack_width(B) if tier == "fused" else 0
+    lanes = 32 // bits if bits else 1
+    words = -(-d // lanes)
+
+    def level_bytes(nodes: int, leaf: bool) -> int:
+        flat = {
+            # scatter: bin matrix + broadcast statistic writes per channel
+            "scatter": n * d * (C + 1) * 4,
+            # stream: uint8 bin matrix (B <= 256) + node ids + channels
+            "stream": n * ((d if B <= 256 else d * 4) + M * 4 + M * C * 4),
+            # pallas histogram kernel: i32 bin matrix + node ids + channels
+            "pallas": n * (d * 4 + M * 4 + M * C * 4),
+            # fused: bit-packed words + node ids + channels
+            "fused": n * (words * 4 + M * 4 + M * C * 4),
+        }
+        if tier != "matmul":
+            return flat[tier]
+        if leaf:
+            # leaf einsum: [n, M, leaves] one-hot + value channels
+            return n * M * (nodes + C) * 4
+        # dense matmul: [n, d*B] bin one-hot + [n, M*nodes*C] stat operand
+        return n * (d * B * 4 + M * nodes * C * 4)
+
+    hbm = sum(
+        level_bytes(2**level, False) for level in range(max_depth)
+    ) + level_bytes(2**max_depth, True)
+    flops = sum(
+        2.0 * n * (M * 2**level * C) * (d * B) for level in range(max_depth)
+    ) + 2.0 * n * M * 2**max_depth * C
+    peak = 197e12 if jax.default_backend() == "tpu" else 1e12
+    return {
+        "hist_tier": tier,
+        "pack_bits": bits,
+        "hbm_bytes_est": int(hbm),
+        "flops_est": float(flops),
+        "peak_flops": float(peak),
+    }
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -845,13 +1124,17 @@ def fit_forest(
     # which exists precisely for shapes whose dense one-hot operands (the
     # pallas fallback path) cannot materialize — at the same 'high'
     # statistic precision the pallas tier maps to.
-    pallas_tier = hist_precision.lower() == "pallas"
+    # the fused tier supersedes the pallas histogram hosting: its kernel
+    # already IS a pallas program (packed input, in-kernel routing)
+    pallas_tier = hist_precision.lower() == "pallas" and hist != "fused"
     if pallas_tier and hist == "auto":
         hist = (
             "matmul" if n * d * B <= _MATMUL_HIST_MAX_CELLS else "stream"
         )
     elif not (pallas_tier and hist == "matmul"):
-        hist = _resolve_hist(hist, n, d, B)
+        hist = _resolve_hist(
+            hist, n, d, B, M=M, C=1 + k, max_depth=max_depth
+        )
     pallas_tier = pallas_tier and hist == "matmul"
     if pallas_tier:
         from spark_ensemble_tpu.ops.pallas_hist import (
@@ -899,6 +1182,15 @@ def fit_forest(
             min_info_gain=min_info_gain, axis_name=axis_name,
             stat_prec=stat_prec, route_prec=route_prec,
             return_leaf=return_leaf,
+        )
+    if hist == "fused":
+        # fused round kernel: like stream, no full-n one-hot ever exists
+        # (one-hots live per block in VMEM), so no budget check either
+        return _fit_forest_fused(
+            Xb, Y, w, thresholds, feature_mask,
+            max_depth=max_depth, max_bins=max_bins,
+            min_info_gain=min_info_gain, axis_name=axis_name,
+            stat_prec=stat_prec, return_leaf=return_leaf,
         )
 
     # budget the fused path by its LARGEST [n, M, ...] intermediate: the

@@ -406,13 +406,21 @@ class FitTelemetry:
                     fence: Any = (), losses: Any = None, step_sizes: Any = None,
                     learner_index: Optional[int] = None,
                     phase: str = "rounds",
-                    divisor: Optional[int] = None) -> float:
+                    divisor: Optional[int] = None,
+                    round_cost: Optional[Dict[str, Any]] = None) -> float:
         """Record ``count`` rounds dispatched as one fused program: fence on
         the chunk outputs, then emit a ``round_start``/``round_end`` pair per
         round at chunk_duration/count each (see module docstring: per-round
         host timestamps inside a scan chunk do not exist).  ``divisor``
         overrides the per-round denominator when the chunk COMPUTED more
-        rounds than it kept (boosting aborts discard the tail)."""
+        rounds than it kept (boosting aborts discard the tail).
+
+        ``round_cost`` (ops/tree.py ``round_cost_est``) attaches the static
+        per-round cost model to every round_end — ``hist_tier``,
+        ``pack_bits``, ``hbm_bytes_est`` — and, combined with the measured
+        per-round duration, a per-round ``mfu_est`` (flops_est /
+        (duration * peak_flops)), so MFU is observable per fit instead of
+        only in one-off captures."""
         if fence is not None and fence != ():
             block_on_arrays(fence)
         now = time.perf_counter()
@@ -424,6 +432,15 @@ class FitTelemetry:
             step_arr = np.asarray(step_sizes, dtype=np.float64)
             step_arr = step_arr.reshape(step_arr.shape[0], -1).mean(axis=1)
         mem = device_memory_stats()
+        cost_fields: Dict[str, Any] = {}
+        if round_cost:
+            for key in ("hist_tier", "pack_bits", "hbm_bytes_est"):
+                if key in round_cost:
+                    cost_fields[key] = round_cost[key]
+            flops = round_cost.get("flops_est")
+            peak = round_cost.get("peak_flops")
+            if flops and peak and per_round > 0:
+                cost_fields["mfu_est"] = float(flops) / (per_round * float(peak))
         for j in range(count):
             rnd = start_round + j
             li = rnd if learner_index is None else learner_index
@@ -436,6 +453,7 @@ class FitTelemetry:
                 "duration_s": per_round,
                 "phases": {"device_round": per_round},
             }
+            end_ev.update(cost_fields)
             if loss_arr is not None and j < loss_arr.shape[0]:
                 end_ev["loss"] = float(loss_arr[j])
             if step_arr is not None and j < step_arr.shape[0]:

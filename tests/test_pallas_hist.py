@@ -199,3 +199,257 @@ def test_pallas_persists_and_validates():
         raise AssertionError("validator must reject unknown tiers")
     except ValueError:
         pass
+
+
+# -- fused round kernel (hist="fused"): bit-packed bins, in-kernel routing --
+
+
+def _fused_forest(Xb, Y, w, thresholds, **kw):
+    return fit_forest(Xb, jnp.asarray(Y), jnp.asarray(w), thresholds,
+                      hist="fused", **kw)
+
+
+def test_pack_unpack_roundtrip():
+    """pack_bins/unpack_bins are exact inverses for every lane width and
+    for feature counts that do and do not fill the last word."""
+    from spark_ensemble_tpu.ops.binning import (
+        pack_bins, pack_width, unpack_bins,
+    )
+
+    rng = np.random.RandomState(10)
+    for B, want_bits in ((12, 4), (16, 4), (200, 8), (256, 8), (500, 32)):
+        assert pack_width(B) == want_bits
+        for d in (1, 7, 8, 16, 17):
+            Xb = rng.randint(0, B, size=(53, d)).astype(np.int32)
+            cb = pack_bins(jnp.asarray(Xb), B, want_bits)
+            assert cb.bits == want_bits
+            np.testing.assert_array_equal(np.asarray(unpack_bins(cb)), Xb)
+
+
+def test_fused_kernel_matches_dense_reference_edge_shapes():
+    """Unrouted level histogram parity against a dense numpy reference at
+    the edge shapes: n not a multiple of the block size (prime), a
+    non-power-of-two bin count, M=1, and zero-weight padding rows."""
+    from spark_ensemble_tpu.ops.binning import pack_bins, pack_width
+    from spark_ensemble_tpu.ops.pallas_hist import fused_round_level
+
+    rng = np.random.RandomState(11)
+    for n, d, M, C, n_nodes, B in (
+        (263, 5, 3, 2, 4, 8),  # prime n -> internal padding
+        (96, 4, 2, 2, 2, 12),  # non-power-of-two bins
+        (64, 3, 1, 3, 4, 16),  # M=1
+    ):
+        bits = pack_width(B)
+        Xb = rng.randint(0, B, size=(n, d)).astype(np.int32)
+        node = rng.randint(0, n_nodes, size=(n, M)).astype(np.int32)
+        vals = (rng.randint(-8, 9, size=(n, M, C)) / 4.0).astype(np.float32)
+        vals[: n // 4] = 0.0  # zero-weight rows must contribute exactly 0
+        cb = pack_bins(jnp.asarray(Xb), B, bits)
+        H, node_out = fused_round_level(
+            cb.packed, jnp.asarray(node), jnp.asarray(vals),
+            n_nodes=n_nodes, max_bins=B, bits=bits, num_features=d,
+        )
+        ref = np.zeros((M, n_nodes, C, d, B), np.float32)
+        for i in range(n):
+            for m in range(M):
+                for f in range(d):
+                    ref[m, node[i, m], :, f, Xb[i, f]] += vals[i, m]
+        np.testing.assert_allclose(np.asarray(H), ref, rtol=0, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(node_out), node)
+
+
+def test_fused_routing_matches_route_members():
+    """Deferred in-kernel routing is bit-identical to `_route_members`."""
+    from spark_ensemble_tpu.ops.binning import pack_bins, pack_width
+    from spark_ensemble_tpu.ops.pallas_hist import fused_round_level
+    from spark_ensemble_tpu.ops.tree import _route_members, _routing_precision
+
+    rng = np.random.RandomState(12)
+    n, d, M, C, B = 301, 6, 3, 2, 16
+    half, n_nodes = 4, 8
+    bits = pack_width(B)
+    Xb = rng.randint(0, B, size=(n, d)).astype(np.int32)
+    prev = rng.randint(0, half, size=(n, M)).astype(np.int32)
+    vals = rng.randn(n, M, C).astype(np.float32)
+    bf = rng.randint(0, d, size=(M, half)).astype(np.int32)
+    bt = rng.randint(0, B, size=(M, half)).astype(np.int32)
+    cb = pack_bins(jnp.asarray(Xb), B, bits)
+    _, node_out = fused_round_level(
+        cb.packed, jnp.asarray(prev), jnp.asarray(vals),
+        jnp.asarray(bf), jnp.asarray(bt),
+        n_nodes=n_nodes, max_bins=B, bits=bits, num_features=d,
+    )
+    ref = _route_members(
+        jnp.asarray(Xb), jnp.asarray(prev), jnp.asarray(bf),
+        jnp.asarray(bt), half, _routing_precision(B),
+    )
+    np.testing.assert_array_equal(np.asarray(node_out), np.asarray(ref))
+
+
+def test_fused_forest_parity_with_scatter_tier():
+    """Same splits as the exact scatter tier on dyadic-rational inputs
+    (the fused kernel's hi/lo statistics are exact there), leaf values
+    allclose."""
+    rng = np.random.RandomState(13)
+    n, d, M, k, B = 640, 6, 3, 1, 16
+    Xb, bins = _binned(rng, n, d, B)
+    Y = (rng.randint(-16, 17, size=(n, M, k)) / 8.0).astype(np.float32)
+    w = (rng.randint(0, 3, size=(n, M)) / 2.0).astype(np.float32)
+    kw = dict(max_depth=3, max_bins=B)
+    exact = fit_forest(Xb, jnp.asarray(Y), jnp.asarray(w), bins.thresholds,
+                       hist="scatter", **kw)
+    fused = _fused_forest(Xb, Y, w, bins.thresholds, **kw)
+    np.testing.assert_array_equal(
+        np.asarray(exact.split_feature), np.asarray(fused.split_feature)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(exact.split_bin), np.asarray(fused.split_bin)
+    )
+    np.testing.assert_allclose(
+        np.asarray(exact.leaf_value), np.asarray(fused.leaf_value),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_fused_forest_return_leaf_ids():
+    """return_leaf must hand back the same leaf ids as the matmul tier —
+    the GBM leaf-id-reuse path depends on it."""
+    rng = np.random.RandomState(14)
+    n, d, M, k, B = 420, 5, 2, 1, 16
+    Xb, bins = _binned(rng, n, d, B)
+    Y = (rng.randint(-8, 9, size=(n, M, k)) / 4.0).astype(np.float32)
+    w = np.ones((n, M), np.float32)
+    kw = dict(max_depth=3, max_bins=B, return_leaf=True)
+    exact, node_e = fit_forest(Xb, jnp.asarray(Y), jnp.asarray(w),
+                               bins.thresholds, hist="matmul", **kw)
+    fused, node_f = _fused_forest(Xb, Y, w, bins.thresholds, **kw)
+    np.testing.assert_array_equal(
+        np.asarray(exact.split_feature), np.asarray(fused.split_feature)
+    )
+    np.testing.assert_array_equal(np.asarray(node_e), np.asarray(node_f))
+
+
+def test_fused_gbm_letter_leg_parity():
+    """The acceptance pin (docs/fused_kernel.md precision contract): a GBM
+    classifier fit with hist='fused' stays tight-allclose to hist='matmul'
+    on the letter-leg workload shape — probabilities within 1e-3, train
+    accuracy within 0.02.  The kernel's 3-term bf16 statistic split is
+    f32-grade (~24-bit mantissa), so split choices match the dense tier
+    up to genuine f32 ties and probabilities track to ~1e-4 even after
+    boosting rounds compound."""
+    rng = np.random.RandomState(15)
+    X = rng.randn(800, 8).astype(np.float32)
+    c = rng.randn(4, 8).astype(np.float32)
+    y = np.argmax(X @ c.T, axis=1).astype(np.float32)
+    cfg = dict(num_base_learners=3, learning_rate=0.5, seed=0)
+
+    def run(tier):
+        m = se.GBMClassifier(
+            base_learner=se.DecisionTreeRegressor(hist=tier, max_bins=16),
+            **cfg,
+        ).fit(X, y)
+        return (
+            np.asarray(m.predict_proba(X)),
+            float(np.mean(np.asarray(m.predict(X)) == y)),
+        )
+
+    p_mat, a_mat = run("matmul")
+    p_fus, a_fus = run("fused")
+    np.testing.assert_allclose(p_fus, p_mat, atol=1e-3)
+    assert abs(a_fus - a_mat) < 0.02, (a_fus, a_mat)
+
+
+def test_fused_vmem_budget_falls_back_with_warning(monkeypatch):
+    """Over the VMEM budget the tier must warn and take the auto fallback
+    (matmul here), producing the fallback tier's exact forest."""
+    import warnings as _warnings
+
+    import spark_ensemble_tpu.ops.pallas_hist as ph
+
+    monkeypatch.setattr(ph, "_FUSED_VMEM_BUDGET", 1)
+    rng = np.random.RandomState(16)
+    n, d, M, k, B = 330, 4, 2, 1, 8  # shapes unique in this file (trace cache)
+    Xb, bins = _binned(rng, n, d, B)
+    Y = (rng.randint(-8, 9, size=(n, M, k)) / 4.0).astype(np.float32)
+    w = np.ones((n, M), np.float32)
+    kw = dict(max_depth=3, max_bins=B)
+    with _warnings.catch_warnings(record=True) as rec:
+        _warnings.simplefilter("always")
+        f = _fused_forest(Xb, Y, w, bins.thresholds, **kw)
+    assert any("hist='fused' falling back" in str(r.message) for r in rec)
+    ref = fit_forest(Xb, jnp.asarray(Y), jnp.asarray(w), bins.thresholds,
+                     hist=se.ops.tree._auto_hist_heuristic(n, d, B), **kw)
+    np.testing.assert_array_equal(
+        np.asarray(f.split_feature), np.asarray(ref.split_feature)
+    )
+    np.testing.assert_allclose(
+        np.asarray(f.leaf_value), np.asarray(ref.leaf_value), rtol=1e-6
+    )
+
+
+def test_fused_off_tpu_large_n_falls_back(monkeypatch):
+    """Off-TPU past _INTERPRET_MAX_ROWS the fused tier must warn and fall
+    back instead of dispatching the interpreted kernel at scale."""
+    import warnings as _warnings
+
+    import spark_ensemble_tpu.ops.pallas_hist as ph
+
+    monkeypatch.setattr(ph, "_INTERPRET_MAX_ROWS", 100)
+    monkeypatch.setattr(ph, "_interpret", lambda: True)
+    rng = np.random.RandomState(17)
+    n, d, M, k, B = 350, 5, 2, 1, 8  # unique shapes (see above)
+    Xb, bins = _binned(rng, n, d, B)
+    Y = rng.randn(n, M, k).astype(np.float32)
+    w = np.ones((n, M), np.float32)
+    with _warnings.catch_warnings(record=True) as rec:
+        _warnings.simplefilter("always")
+        f = _fused_forest(Xb, Y, w, bins.thresholds, max_depth=3, max_bins=B)
+    assert any("hist='fused' falling back" in str(r.message) for r in rec)
+    assert np.isfinite(np.asarray(f.leaf_value)).all()
+
+
+def test_fused_max_bins_over_256_falls_back():
+    """B > 256 is outside the packable range AND the routing exactness
+    proof; the tier must resolve away from fused."""
+    from spark_ensemble_tpu.ops.tree import _resolve_hist
+
+    assert _resolve_hist("fused", 1000, 4, 300, warn=False) != "fused"
+    assert _resolve_hist("fused", 1000, 4, 256, warn=False) == "fused"
+
+
+def test_auto_resolution_never_picks_fused():
+    """Bit-identity contract: with autotune off and hist unset, resolution
+    is exactly the pre-fused heuristic — 'auto' never lands on the fused
+    tier unless a measured winner says so."""
+    from spark_ensemble_tpu import autotune as at
+    from spark_ensemble_tpu.ops.tree import _resolve_hist
+
+    with at.override(mode="off"):
+        for n in (100, 10_000, 5_000_000):
+            assert _resolve_hist("auto", n, 16, 64, warn=False) != "fused"
+
+
+def test_fused_kernel_lowers_for_tpu(monkeypatch):
+    """The REAL (non-interpret) fused kernel must lower through Mosaic for
+    the TPU target at the benchmark shapes — routed level + leaf pass."""
+    from jax import export
+
+    import spark_ensemble_tpu.ops.pallas_hist as ph
+
+    monkeypatch.setattr(ph, "_interpret", lambda: False)
+    n, d, M, C, B = 15000, 16, 26, 2, 16
+    bits = 4
+    W = -(-d // (32 // bits))
+    for n_nodes, half, leaf in ((16, 8, False), (32, 16, True)):
+        exp = export.export(ph._fused_round_level, platforms=("tpu",))(
+            jnp.zeros((n, W), jnp.uint32),
+            jnp.zeros((n, M), jnp.int32),
+            jnp.zeros((n, M, C), jnp.float32),
+            jnp.zeros((M, half), jnp.int32),
+            jnp.zeros((M, half), jnp.int32),
+            n_nodes=n_nodes, max_bins=B, bits=bits, num_features=d,
+            leaf=leaf, route=True, blk=ph.fused_block_rows(),
+        )
+        assert "tpu_custom_call" in exp.mlir_module()
+    # see test_kernel_lowers_for_tpu: drop the interpret=False traces
+    jax.clear_caches()

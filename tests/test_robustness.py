@@ -558,3 +558,49 @@ def test_pipelined_kill_and_resume_matches_sync(
     ]
     assert resumes and resumes[0]["round"] >= 1
     assert np.array_equal(np.asarray(m.predict(X)), p_ref)
+
+
+# ---------------------------------------------------------------------------
+# fused round kernel (hist="fused") under the robustness machinery
+# ---------------------------------------------------------------------------
+
+
+def _fused_gbm(ckdir=None):
+    kw = dict(checkpoint_dir=ckdir, checkpoint_interval=1) if ckdir else {}
+    return se.GBMRegressor(
+        num_base_learners=6, scan_chunk=2,
+        base_learner=se.DecisionTreeRegressor(hist="fused", max_bins=16),
+        **kw,
+    )
+
+
+def test_fused_gbm_recovers_from_nan_round():
+    """The numeric guard sees the fused tier's rounds like any other: a
+    chaos-poisoned round is skipped and the fit completes finite."""
+    X, y = _data()
+    ctl = _chaos(faults=("nan_grad",), budgets={"nan_grad": 1})
+    m = _fused_gbm().copy(on_nonfinite="skip_round").fit(X, y)
+    assert ctl.fired
+    assert np.all(np.isfinite(np.asarray(m.predict(X))))
+
+
+def test_fused_kill_and_resume_matches_uninterrupted(tmp_path):
+    """Crash-consistent resume with hist='fused': the resumed fit must be
+    bit-identical to an uninterrupted fused fit — the packed-bins state is
+    rebuilt from data, never checkpointed, so replay determinism holds."""
+    X, y = _data()
+    p_ref = np.asarray(_fused_gbm().fit(X, y).predict(X))
+
+    est = _fused_gbm(str(tmp_path / "ck"))
+    _chaos(seed=3, faults=("preempt",), budgets={"preempt": 1})
+    with pytest.raises(ChaosPreemption):
+        est.fit(X, y)
+    chaos.install(None)
+
+    with record_fits() as rec:
+        m = est.fit(X, y)  # resumes from the checkpoint
+    resumes = [
+        e for e in rec.events if e["event"] == "resume_from_checkpoint"
+    ]
+    assert resumes and resumes[0]["round"] >= 1
+    assert np.array_equal(np.asarray(m.predict(X)), p_ref)

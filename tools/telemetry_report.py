@@ -106,6 +106,35 @@ def round_stats(fit_events: List[dict]) -> Optional[dict]:
     return out
 
 
+def round_cost_line(fit_events: List[dict]) -> Optional[str]:
+    """Static round-cost summary from the round_end events: the resolved
+    histogram tier, packed-lane width, modeled HBM bytes per round, and the
+    MFU estimate against the static flop count (ops/tree.py
+    ``round_cost_est``).  One line per fit — the fields are shape-derived
+    and identical across rounds."""
+    ev = next(
+        (
+            e
+            for e in fit_events
+            if e.get("event") == "round_end" and "hist_tier" in e
+        ),
+        None,
+    )
+    if ev is None:
+        return None
+    parts = [f"hist_tier: {ev['hist_tier']}"]
+    bits = ev.get("pack_bits")
+    if bits:
+        parts.append(f"pack {bits}-bit")
+    hbm = ev.get("hbm_bytes_est")
+    if hbm is not None:
+        parts.append(f"hbm/round {float(hbm) / 2**20:.2f} MiB")
+    mfu = ev.get("mfu_est")
+    if mfu is not None:
+        parts.append(f"mfu_est {100.0 * float(mfu):.2f}%")
+    return "  ".join(parts)
+
+
 def render_fit(fit_id: str, fit_events: List[dict]) -> str:
     lines = [f"== {fit_id} =="]
     start = next(
@@ -157,6 +186,9 @@ def render_fit(fit_id: str, fit_events: List[dict]) -> str:
             f"p50 {stats['p50_s'] * 1e3:.2f}ms  max {stats['max_s'] * 1e3:.2f}ms"
             f"{loss_part}"
         )
+    cost = round_cost_line(fit_events)
+    if cost:
+        lines.append(cost)
     probe = next(
         (e for e in fit_events if e.get("event") == "phase_probe"), None
     )
