@@ -619,7 +619,8 @@ def inner():
 
     # predict throughput (argmax path; jitted, steady-state)
     Xd = jax.numpy.asarray(X)
-    jax.block_until_ready(model.predict(Xd))  # compile at the timed shape
+    # graftlint: ignore[unfenced-blocking-read] -- warmup compile at the timed shape, deliberately outside the timed window
+    jax.block_until_ready(model.predict(Xd))
     t0 = time.perf_counter()
     reps = 20
     for _ in range(reps):
@@ -790,6 +791,7 @@ def inner():
             ),
             {},
         )
+        # graftlint: ignore[unfenced-blocking-read] -- accuracy readback after the timed fit, outside the dispatch window
         acc = float(np.mean(np.asarray(leg_model.predict(Xab)) == yab))
         return leg_s, rend, acc
 
@@ -933,6 +935,7 @@ def inner():
                 t_est.fit(X, y)  # warmup/compile
                 t_model, t_fit = _timed_fit(t_est, X, y)
                 t_acc = float(
+                    # graftlint: ignore[unfenced-blocking-read] -- accuracy readback after the timed fit, outside the dispatch window
                     np.mean(np.asarray(t_model.predict(Xd)) == y)
                 )
                 extras[f"tier_{tier}_iters_per_sec"] = round(

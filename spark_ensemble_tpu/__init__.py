@@ -124,6 +124,13 @@ from spark_ensemble_tpu.autotune import (
     enable_compilation_cache,
     run_search,
 )
+from spark_ensemble_tpu import analysis
+from spark_ensemble_tpu.analysis import (
+    ContractReport,
+    check_contracts,
+    lint_paths,
+    trace_contracts,
+)
 from spark_ensemble_tpu.execution import (
     device_patience_enabled,
     resolve_pipeline_depth,
@@ -209,5 +216,9 @@ __all__ = [
     "resolve_pipeline_depth",
     "device_patience_enabled",
     "shared_fit_context",
+    "lint_paths",
+    "ContractReport",
+    "check_contracts",
+    "trace_contracts",
     "load",
 ]

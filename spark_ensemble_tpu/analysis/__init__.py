@@ -1,0 +1,64 @@
+"""JAX-aware static analysis: the ``graftlint`` two-tier gate.
+
+The performance subsystems layered onto this package (lookahead dispatch
+pipeline, autotuned execution, fused Pallas round kernel, AOT serving,
+chaos runtime) all rest on invariants nothing in the type system checks:
+bit-identity requires PRNG keys derived from absolute round indices (never
+reused), throughput requires zero retraces and bounded compiles, and the
+pipeline requires no unfenced blocking host reads inside the dispatch
+window.  This package checks those invariants mechanically, before a
+change lands:
+
+- **Tier 1** (:mod:`~spark_ensemble_tpu.analysis.lint`): a visitor-based
+  AST linter with JAX-specific pluggable rules
+  (:mod:`~spark_ensemble_tpu.analysis.rules`) — key reuse, Python
+  branching on traced values, non-hashable ``static_argnums``, jitted
+  closures over mutable state, unfenced blocking reads, f64 upcasts, host
+  calls inside jitted scope.  Findings carry ``file:line`` + rule id;
+  ``# graftlint: ignore[rule] -- reason`` suppresses with a mandatory
+  justification.
+- **Tier 2** (:mod:`~spark_ensemble_tpu.analysis.contracts`): an
+  abstract-tracing program-contract checker that traces the public
+  ``fit``/``predict``/``predict_proba`` entry points of all four ensemble
+  families (plus the serving-engine warmup path) on canonical shape
+  classes and asserts machine-checkable contracts — program-count budgets
+  pinned against the committed ``analysis/contracts.json`` baseline, no
+  f64 in any jaxpr, no host callbacks, donation consumed, collective axis
+  names confined to the ``{dcn_data, data, member}`` mesh.
+
+Both tiers run from ``tools/graftlint.py`` (also the ``graftlint``
+console script) and gate CI (docs/static_analysis.md).
+"""
+
+from spark_ensemble_tpu.analysis.contracts import (
+    ContractReport,
+    ContractViolation,
+    check_contracts,
+    trace_contracts,
+    update_baseline,
+)
+from spark_ensemble_tpu.analysis.lint import (
+    Finding,
+    LintRule,
+    all_rules,
+    lint_file,
+    lint_paths,
+    register_rule,
+)
+
+# importing the rules module populates the registry
+from spark_ensemble_tpu.analysis import rules as _rules  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "LintRule",
+    "all_rules",
+    "lint_file",
+    "lint_paths",
+    "register_rule",
+    "ContractReport",
+    "ContractViolation",
+    "check_contracts",
+    "trace_contracts",
+    "update_baseline",
+]

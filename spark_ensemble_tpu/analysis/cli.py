@@ -1,0 +1,148 @@
+"""``graftlint`` command line: both tiers, one exit code.
+
+Exit 0 iff the repo is clean — zero unsuppressed tier-1 findings and
+(with ``--contracts``) zero contract violations.  Suppressed findings
+are listed (with their justifications) but never fail the run; the
+``--jsonl`` artifact carries every finding, suppressed or not, in the
+flat-record shape ``tools/telemetry_report.py`` reads.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description=(
+            "JAX-aware static analysis for spark_ensemble_tpu: AST lint "
+            "(tier 1) + traced program contracts (tier 2)."
+        ),
+    )
+    p.add_argument(
+        "targets",
+        nargs="*",
+        help="files/directories to lint (default: the package, tools/, "
+        "bench.py, __graft_entry__.py)",
+    )
+    p.add_argument(
+        "--select",
+        action="append",
+        metavar="RULE",
+        help="run only these rule ids (repeatable)",
+    )
+    p.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule catalogue (id + doc) and exit",
+    )
+    p.add_argument(
+        "--jsonl",
+        metavar="PATH",
+        help="write every finding (and contract violation) as JSONL",
+    )
+    p.add_argument(
+        "--no-lint",
+        action="store_true",
+        help="skip tier 1 (contracts only)",
+    )
+    p.add_argument(
+        "--contracts",
+        action="store_true",
+        help="also run tier 2: trace fit/predict of every family + the "
+        "serving warmup and check budgets against analysis/contracts.json",
+    )
+    p.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="re-trace and rewrite analysis/contracts.json, then exit",
+    )
+    p.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="list suppressed findings with their justifications",
+    )
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    from spark_ensemble_tpu.analysis import lint as lint_mod
+
+    if args.list_rules:
+        for rule_id, rule in sorted(lint_mod.all_rules().items()):
+            print(f"{rule_id}")
+            print(f"    {rule.doc}")
+        return 0
+
+    if args.update_baseline:
+        from spark_ensemble_tpu.analysis import contracts as contracts_mod
+
+        base = contracts_mod.update_baseline()
+        print(
+            f"wrote {contracts_mod._BASELINE_PATH} "
+            f"({len(base['entry_points'])} entry points)"
+        )
+        for entry, n in sorted(base["entry_points"].items()):
+            print(f"  {entry}: {n} programs")
+        return 0
+
+    records: List[dict] = []
+    failures = 0
+
+    if not args.no_lint:
+        findings = lint_mod.lint_paths(
+            targets=args.targets or None, select=args.select
+        )
+        records.extend(f.to_record() for f in findings)
+        for f in findings:
+            if f.suppressed:
+                if args.show_suppressed:
+                    print(
+                        f"{f.location()}: {f.rule} [suppressed: "
+                        f"{f.justification}]"
+                    )
+                continue
+            failures += 1
+            print(f"{f.location()}:{f.col}: {f.rule}: {f.message}")
+        n_sup = sum(1 for f in findings if f.suppressed)
+        print(
+            f"graftlint tier 1: {failures} finding(s), "
+            f"{n_sup} suppressed (justified)"
+        )
+
+    if args.contracts:
+        from spark_ensemble_tpu.analysis import contracts as contracts_mod
+
+        report = contracts_mod.check_contracts()
+        records.extend(v.to_record() for v in report.violations)
+        for entry, n in sorted(report.budgets.items()):
+            records.append(
+                {"event": "contract_budget", "entry_point": entry,
+                 "programs": n}
+            )
+        for v in report.violations:
+            failures += 1
+            print(f"contract {v.contract}: {v.entry_point}: {v.message}")
+        for entry, why in sorted(report.skipped.items()):
+            print(f"contract skipped: {entry}: {why}")
+        print(
+            f"graftlint tier 2: {len(report.budgets)} entry points, "
+            f"{len(report.violations)} violation(s)"
+        )
+
+    if args.jsonl:
+        with open(args.jsonl, "w") as f:
+            for rec in records:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,580 @@
+"""Tier-2: traced program contracts for the public entry points.
+
+Tier 1 reads source; this tier reads **programs**.  It fits and predicts
+every ensemble family on tiny canonical shape classes with a
+program-call observer registered at the ``cached_program`` /
+``_predict_program`` chokepoint (:func:`~spark_ensemble_tpu.models.base.
+observe_program_calls`), abstractly re-traces each distinct program once
+(``jax.make_jaxpr``), and asserts the machine-checkable contracts the
+performance subsystems depend on:
+
+- **compile budgets**: the number of distinct ``(program tag, abstract
+  argument signature)`` pairs each entry point dispatches, pinned
+  against the committed ``analysis/contracts.json`` baseline.  Counting
+  *signatures* rather than backend compiles makes the budget immune to
+  cache warmth, the persistent compilation cache, and chaos-retry
+  replays (a retry re-calls the same signature); a NEW signature is
+  exactly what jit would retrace on, so drift here is retrace drift.
+- **no f64**: no float64/complex128 aval anywhere in any traced jaxpr
+  (the f32 dtype policy, enforced end-to-end).
+- **no host callbacks**: no ``pure_callback``/``io_callback``/debug
+  callback primitives inside round-loop programs — a host callback in a
+  round body re-serializes the dispatch pipeline the lookahead exists
+  to overlap.
+- **collective axes**: every ``axis_name`` appearing in any program is
+  one of the blessed mesh axes ``{dcn_data, data, member}``.
+- **donation consumed** (serving, non-CPU backends only): warming the
+  engine must not raise "donated buffers were not usable" warnings.
+- **serving warmup**: exactly ``len(methods) x len(buckets)`` AOT
+  programs, and steady-state serving performs zero backend compiles.
+
+Tracing runs under a scrubbed environment (chaos, device patience,
+telemetry phases, pipeline depth pinned; autotune forced ``off``) so
+the observed program set is a pure function of the code — the property
+that lets ``contracts.json`` live in git.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import warnings
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: mesh axis names blessed by the distributed design (docs/distributed.md)
+ALLOWED_AXES = frozenset({"dcn_data", "data", "member"})
+
+#: jaxpr primitives that call back into the host
+_CALLBACK_PRIMITIVES = frozenset(
+    {"pure_callback", "io_callback", "debug_callback", "callback",
+     "host_callback", "outside_call", "infeed", "outfeed"}
+)
+
+#: program tags that form the per-round hot loop — host callbacks are
+#: forbidden specifically there (a callback per round stalls the pipeline)
+_ROUND_LOOP_TAGS = ("chunk", "round", "fit", "scan")
+
+#: canonical shape class every family is traced on: small enough for CPU
+#: CI, large enough to exercise binning/bucketing
+_N, _D, _K = 64, 6, 3
+
+_BASELINE_PATH = os.path.join(os.path.dirname(__file__), "contracts.json")
+
+
+@dataclass
+class ContractViolation:
+    contract: str  # budget | f64 | host-callback | axis-name | donation | serving
+    entry_point: str
+    message: str
+
+    def to_record(self) -> dict:
+        return {
+            "event": "contract_violation",
+            "contract": self.contract,
+            "entry_point": self.entry_point,
+            "message": self.message,
+        }
+
+
+@dataclass
+class ContractReport:
+    """Outcome of one contract trace: per-entry-point program budgets plus
+    every violation found (empty == the repo honors its contracts)."""
+
+    budgets: Dict[str, int] = field(default_factory=dict)
+    violations: List[ContractViolation] = field(default_factory=list)
+    skipped: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def baseline(self) -> dict:
+        """The committed-baseline shape: versioned, sorted, timestamp-free
+        (byte-stable across runs, so git diffs are semantic)."""
+        return {
+            "version": 1,
+            "entry_points": {k: self.budgets[k] for k in sorted(self.budgets)},
+        }
+
+
+class _ProgramRecorder:
+    """Observer for :func:`observe_program_calls`: counts distinct
+    (tag, signature) programs and abstractly re-traces each one once."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.programs: Dict[Tuple[str, tuple], Any] = {}
+
+    def __call__(self, tag, sig, fn, args, kwargs):
+        key = (tag, sig)
+        with self._lock:
+            if key in self.programs:
+                return
+            self.programs[key] = None
+        jaxpr = None
+        try:
+            import jax
+
+            jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+        except Exception:  # abstract re-trace is best-effort per program
+            jaxpr = None
+        with self._lock:
+            self.programs[key] = jaxpr
+
+    def count(self) -> int:
+        return len(self.programs)
+
+
+def _scrubbed_env():
+    """Pin every behavior-bearing env knob to the canonical contract
+    configuration for the enclosed trace (restored on exit)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _scope():
+        saved = {
+            k: os.environ.pop(k)
+            for k in list(os.environ)
+            if k.startswith("SE_TPU_")
+        }
+        os.environ["SE_TPU_AUTOTUNE"] = "off"
+        os.environ["SE_TPU_PIPELINE"] = "0"
+        try:
+            yield
+        finally:
+            for k in list(os.environ):
+                if k.startswith("SE_TPU_"):
+                    del os.environ[k]
+            os.environ.update(saved)
+
+    return _scope()
+
+
+def _canonical_data(classification: bool):
+    rng = np.random.default_rng(7)
+    X = rng.standard_normal((_N, _D)).astype(np.float32)
+    if classification:
+        y = (np.arange(_N) % _K).astype(np.int32)
+        rng.shuffle(y)
+    else:
+        y = (X @ rng.standard_normal(_D) + 0.1 * rng.standard_normal(_N)).astype(
+            np.float32
+        )
+    return X, y
+
+
+def _entry_points() -> Dict[str, dict]:
+    """Constructors for the canonical contract fixtures: every family,
+    classifier + regressor, smallest configs that still run the real
+    round drivers."""
+    import spark_ensemble_tpu as se
+
+    def tree_r():
+        return se.DecisionTreeRegressor(max_depth=3)
+
+    def tree_c():
+        return se.DecisionTreeClassifier(max_depth=3)
+
+    return {
+        "gbm_regressor": dict(
+            make=lambda: se.GBMRegressor(
+                base_learner=tree_r(), num_base_learners=3, seed=0
+            ),
+            classification=False,
+        ),
+        "gbm_classifier": dict(
+            make=lambda: se.GBMClassifier(
+                base_learner=tree_r(), num_base_learners=3, seed=0
+            ),
+            classification=True,
+        ),
+        "boosting_regressor": dict(
+            make=lambda: se.BoostingRegressor(
+                base_learner=tree_r(), num_base_learners=3, seed=0
+            ),
+            classification=False,
+        ),
+        "boosting_classifier": dict(
+            make=lambda: se.BoostingClassifier(
+                base_learner=tree_c(), num_base_learners=3, seed=0
+            ),
+            classification=True,
+        ),
+        "bagging_regressor": dict(
+            make=lambda: se.BaggingRegressor(
+                base_learner=tree_r(), num_base_learners=3, seed=0
+            ),
+            classification=False,
+        ),
+        "bagging_classifier": dict(
+            make=lambda: se.BaggingClassifier(
+                base_learner=tree_c(), num_base_learners=3, seed=0
+            ),
+            classification=True,
+        ),
+        "stacking_regressor": dict(
+            make=lambda: se.StackingRegressor(
+                base_learners=[tree_r(), se.LinearRegression()],
+                stacker=se.LinearRegression(),
+            ),
+            classification=False,
+        ),
+        "stacking_classifier": dict(
+            make=lambda: se.StackingClassifier(
+                base_learners=[tree_c(), se.LogisticRegression()],
+                stacker=se.LogisticRegression(),
+            ),
+            classification=True,
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+
+
+def _iter_eqns(jaxpr):
+    """Every equation in a (Closed)Jaxpr, recursing into sub-jaxprs
+    (pjit bodies, scan/while/cond branches, custom-call closures)."""
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in getattr(inner, "eqns", ()):
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _sub_jaxprs(v):
+                yield from _iter_eqns(sub)
+
+
+def _sub_jaxprs(value):
+    if hasattr(value, "eqns") or hasattr(value, "jaxpr"):
+        yield value
+    elif isinstance(value, (tuple, list)):
+        for item in value:
+            yield from _sub_jaxprs(item)
+
+
+def _iter_avals(jaxpr):
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    for v in list(getattr(inner, "invars", ())) + list(
+        getattr(inner, "outvars", ())
+    ):
+        aval = getattr(v, "aval", None)
+        if aval is not None:
+            yield aval
+    for eqn in _iter_eqns(jaxpr):
+        for v in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(v, "aval", None)
+            if aval is not None:
+                yield aval
+
+
+def _check_jaxpr(entry: str, tag: str, jaxpr, out: List[ContractViolation]):
+    wide = set()
+    for aval in _iter_avals(jaxpr):
+        dt = str(getattr(aval, "dtype", ""))
+        # int64 index arithmetic is tolerated; wide FLOATS are the policy
+        # violation (they double bandwidth through every histogram)
+        if dt in ("float64", "complex128") and dt not in wide:
+            wide.add(dt)
+            out.append(
+                ContractViolation(
+                    "f64",
+                    entry,
+                    f"program `{tag}` carries a {dt} value: f32 "
+                    "dtype policy violation",
+                )
+            )
+    is_round_loop = any(t in tag for t in _ROUND_LOOP_TAGS)
+    for eqn in _iter_eqns(jaxpr):
+        prim = getattr(eqn.primitive, "name", str(eqn.primitive))
+        if prim in _CALLBACK_PRIMITIVES and is_round_loop:
+            out.append(
+                ContractViolation(
+                    "host-callback",
+                    entry,
+                    f"round-loop program `{tag}` embeds host callback "
+                    f"primitive `{prim}`: re-serializes the dispatch "
+                    "pipeline",
+                )
+            )
+        axis = eqn.params.get("axis_name")
+        if axis is not None:
+            axes = axis if isinstance(axis, (tuple, list)) else (axis,)
+            for a in axes:
+                if isinstance(a, str) and a not in ALLOWED_AXES:
+                    out.append(
+                        ContractViolation(
+                            "axis-name",
+                            entry,
+                            f"program `{tag}` uses collective axis "
+                            f"`{a}` outside the blessed mesh axes "
+                            f"{sorted(ALLOWED_AXES)}",
+                        )
+                    )
+
+
+# ---------------------------------------------------------------------------
+# the trace
+# ---------------------------------------------------------------------------
+
+
+def _trace_family(name: str, spec: dict, report: ContractReport) -> None:
+    import jax
+
+    from spark_ensemble_tpu.models.base import observe_program_calls
+
+    X, y = _canonical_data(spec["classification"])
+    est = spec["make"]()
+
+    rec = _ProgramRecorder()
+    with observe_program_calls(rec):
+        model = est.fit(X, y)
+    report.budgets[f"{name}.fit"] = rec.count()
+    for (tag, _), jaxpr in rec.programs.items():
+        if jaxpr is not None:
+            _check_jaxpr(f"{name}.fit", tag, jaxpr, report.violations)
+
+    if name.startswith("stacking"):
+        # stacking fits heterogeneous members EAGERLY (no cached-program
+        # dispatch — the 0 budget above pins exactly that), so its fit-side
+        # dtype/callback coverage comes from abstractly tracing each base
+        # learner's functional fit instead
+        _check_stacking_member_fits(name, est, X, y, spec, report)
+
+    methods = ["predict"]
+    if spec["classification"]:
+        methods.append("predict_proba")
+    Xs = jax.ShapeDtypeStruct((_N, _D), np.float32)
+    for method in methods:
+        if not hasattr(model, method):
+            continue
+        rec = _ProgramRecorder()
+        with observe_program_calls(rec):
+            getattr(model, method)(X)
+        report.budgets[f"{name}.{method}"] = rec.count()
+        for (tag, _), jaxpr in rec.programs.items():
+            if jaxpr is not None:
+                _check_jaxpr(
+                    f"{name}.{method}", tag, jaxpr, report.violations
+                )
+        # whole-entry-point jaxpr: traces THROUGH the per-program plumbing
+        # (covers families whose predicts run eagerly, e.g. stacking
+        # members) — the authoritative no-f64/no-callback/axis surface
+        try:
+            full = jax.make_jaxpr(getattr(model, method))(Xs)
+        except Exception as e:  # noqa: BLE001 - any trace failure is a skip
+            report.skipped[f"{name}.{method}.jaxpr"] = (
+                f"entry point not abstractly traceable: {e!r:.120}"
+            )
+        else:
+            _check_jaxpr(
+                f"{name}.{method}", "full_entry", full, report.violations
+            )
+
+
+def _check_stacking_member_fits(
+    name: str, est, X, y, spec: dict, report: ContractReport
+) -> None:
+    import jax
+
+    from spark_ensemble_tpu.models.base import as_f32
+
+    num_classes = _K if spec["classification"] else None
+    key = jax.random.PRNGKey(0)
+    y_aval = jax.ShapeDtypeStruct((_N,), np.float32)
+    w_aval = jax.ShapeDtypeStruct((_N,), np.float32)
+    for base in est._bases():
+        ctx = base.make_fit_ctx(
+            as_f32(X), num_classes if base.is_classifier else None
+        )
+        label = f"member_fit:{type(base).__name__}"
+        try:
+            jaxpr = jax.make_jaxpr(
+                lambda yy, ww, _b=base, _c=ctx: _b.fit_from_ctx(
+                    _c, yy, ww, None, key
+                )
+            )(y_aval, w_aval)
+        except Exception as e:  # noqa: BLE001
+            report.skipped[f"{name}.fit.{label}"] = (
+                f"member fit not abstractly traceable: {e!r:.120}"
+            )
+            continue
+        _check_jaxpr(f"{name}.fit", label, jaxpr, report.violations)
+
+
+def _trace_serving(report: ContractReport) -> None:
+    import jax
+
+    from spark_ensemble_tpu.serving.engine import InferenceEngine
+    from spark_ensemble_tpu.telemetry.events import compile_snapshot
+
+    import spark_ensemble_tpu as se
+
+    X, y = _canonical_data(False)
+    model = se.GBMRegressor(
+        base_learner=se.DecisionTreeRegressor(max_depth=3),
+        num_base_learners=3,
+        seed=0,
+    ).fit(X, y)
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        engine = InferenceEngine(
+            model,
+            methods=("predict",),
+            min_bucket=8,
+            max_batch_size=32,
+            warm=True,
+        )
+    try:
+        expected = len(engine._methods) * len(engine.buckets)
+        got = len(engine._compiled)
+        report.budgets["serving.warmup"] = got
+        if got != expected:
+            report.violations.append(
+                ContractViolation(
+                    "serving",
+                    "serving.warmup",
+                    f"warmup compiled {got} programs, expected "
+                    f"len(methods) x len(buckets) = {expected}",
+                )
+            )
+        if jax.default_backend() == "cpu":
+            report.skipped["serving.donation"] = (
+                "buffer donation is not implemented on the cpu backend"
+            )
+        else:
+            unusable = [
+                w for w in caught
+                if "donated" in str(w.message).lower()
+                and "not usable" in str(w.message).lower()
+            ]
+            if unusable:
+                report.violations.append(
+                    ContractViolation(
+                        "donation",
+                        "serving.warmup",
+                        "donated request buffers were not consumed: "
+                        + str(unusable[0].message)[:200],
+                    )
+                )
+        # steady state: serving several real batch sizes after warmup must
+        # perform zero backend compiles (the whole point of the buckets)
+        before = compile_snapshot()[0]
+        for n in (1, 7, 9, 30):
+            engine.predict(X[:n])
+        after = compile_snapshot()[0]
+        if after != before:
+            report.violations.append(
+                ContractViolation(
+                    "serving",
+                    "serving.steady_state",
+                    f"{after - before} backend compile(s) during warmed "
+                    "steady-state serving (must be zero)",
+                )
+            )
+    finally:
+        engine.stop()
+
+
+def trace_contracts(
+    entry_points: Optional[List[str]] = None,
+) -> ContractReport:
+    """Fit/predict every family (plus serving warmup) on the canonical
+    shape classes under the scrubbed environment, and return the budgets
+    and intrinsic violations (f64 / host-callback / axis / donation /
+    serving).  Budget *drift* is judged separately by
+    :func:`check_contracts` against the committed baseline."""
+    report = ContractReport()
+    specs = _entry_points()
+    wanted = set(entry_points) if entry_points else None
+    with _scrubbed_env():
+        for name, spec in specs.items():
+            if wanted is not None and name not in wanted:
+                continue
+            _trace_family(name, spec, report)
+        if wanted is None or "serving" in wanted:
+            _trace_serving(report)
+    return report
+
+
+def load_baseline(path: Optional[str] = None) -> Optional[dict]:
+    path = path or _BASELINE_PATH
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def check_contracts(
+    baseline: Optional[dict] = None,
+    report: Optional[ContractReport] = None,
+    entry_points: Optional[List[str]] = None,
+) -> ContractReport:
+    """Trace (unless a ``report`` is supplied) and verify the budgets
+    against ``baseline`` (default: the committed ``contracts.json``).
+    Budget drift — an entry point dispatching MORE or FEWER distinct
+    programs than pinned — is appended as ``budget`` violations with the
+    one-command fix in the message."""
+    if report is None:
+        report = trace_contracts(entry_points)
+    if baseline is None:
+        baseline = load_baseline()
+    if baseline is None:
+        report.violations.append(
+            ContractViolation(
+                "budget",
+                "*",
+                "no committed baseline (analysis/contracts.json); "
+                "generate one with `python tools/graftlint.py "
+                "--update-baseline`",
+            )
+        )
+        return report
+    pinned: Dict[str, int] = baseline.get("entry_points", {})
+    for entry in sorted(set(pinned) | set(report.budgets)):
+        if entry_points and not any(
+            entry.startswith(e) for e in entry_points
+        ):
+            continue
+        want, got = pinned.get(entry), report.budgets.get(entry)
+        if want is None:
+            report.violations.append(
+                ContractViolation(
+                    "budget",
+                    entry,
+                    f"entry point not in the committed baseline (traces "
+                    f"{got} programs); re-pin with `python "
+                    "tools/graftlint.py --update-baseline`",
+                )
+            )
+        elif got is None:
+            continue  # partial trace: entry not requested this run
+        elif got != want:
+            report.violations.append(
+                ContractViolation(
+                    "budget",
+                    entry,
+                    f"compile budget drift: {got} distinct programs vs "
+                    f"{want} pinned; if intentional re-pin with `python "
+                    "tools/graftlint.py --update-baseline`",
+                )
+            )
+    return report
+
+
+def update_baseline(path: Optional[str] = None) -> dict:
+    """Regenerate ``analysis/contracts.json`` from a fresh trace (the
+    ``--update-baseline`` flow) and return the written baseline."""
+    report = trace_contracts()
+    base = report.baseline()
+    path = path or _BASELINE_PATH
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(base, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return base

@@ -1,0 +1,962 @@
+"""The JAX-aware lint rules (tier 1 of graftlint).
+
+Every rule is a small, conservative AST check: it flags only patterns it
+can see locally and resolves names through the module's imports, so the
+false-positive rate stays near zero at the cost of missing exotic
+constructions.  Each rule documents its exact trigger in ``doc`` (the
+rule catalogue in docs/static_analysis.md is generated from these) and
+has a minimal positive + negative fixture under ``tests/fixtures/lint/``.
+
+Shared machinery here:
+
+- :class:`ImportMap` resolves dotted names through the module's imports
+  (``jnp.float64`` -> ``jax.numpy.float64``).
+- :func:`find_traced_scopes` marks the functions JAX will trace —
+  jit-decorated defs, defs passed to ``jax.jit``/``vmap``/``grad``/
+  ``lax.scan``-family combinators, and everything lexically nested in
+  them — along with their static argument names.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from spark_ensemble_tpu.analysis.lint import (
+    FileContext,
+    Finding,
+    LintRule,
+    register_rule,
+)
+
+
+class ImportMap:
+    """Resolve AST name/attribute chains to canonical dotted paths."""
+
+    def __init__(self, tree: ast.Module):
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.level:  # relative imports stay package-local
+                    continue
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = (
+                        f"{node.module}.{a.name}"
+                    )
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted path for a Name/Attribute chain with the import alias
+        expanded, or None for non-name expressions."""
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        head = self.aliases.get(cur.id, cur.id)
+        return ".".join([head] + list(reversed(parts)))
+
+
+# canonical prefixes (after alias expansion) that mean "this function is
+# traced by JAX"; the int tuples name the positional args that are traced
+# callables
+_TRACING_WRAPPERS: Dict[str, Tuple[int, ...]] = {
+    "jax.jit": (0,),
+    "jax.vmap": (0,),
+    "jax.pmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "jax.lax.scan": (0,),
+    "jax.lax.map": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": (1,),
+}
+
+
+@dataclass
+class TracedScope:
+    node: ast.AST  # FunctionDef | Lambda
+    reason: str
+    static_names: Set[str] = field(default_factory=set)
+
+    @property
+    def params(self) -> List[str]:
+        args = self.node.args
+        names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        return [n for n in names if n != "self"]
+
+
+def _static_names_from_call(call: ast.Call, fn_node) -> Set[str]:
+    """Static parameter NAMES for the wrapped function, from literal
+    ``static_argnums``/``static_argnames`` keywords on a jit call."""
+    names: Set[str] = set()
+    pos: List[str] = []
+    if isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        a = fn_node.args
+        pos = [x.arg for x in a.posonlyargs + a.args]
+    for kw in call.keywords:
+        if kw.arg not in ("static_argnums", "static_argnames"):
+            continue
+        try:
+            val = ast.literal_eval(kw.value)
+        except (ValueError, SyntaxError):
+            continue
+        items = val if isinstance(val, (tuple, list)) else (val,)
+        for item in items:
+            if isinstance(item, str):
+                names.add(item)
+            elif isinstance(item, int) and 0 <= item < len(pos):
+                names.add(pos[item])
+    return names
+
+
+def find_traced_scopes(tree: ast.Module, imports: ImportMap) -> dict:
+    """Map of def/lambda node -> :class:`TracedScope` for every function
+    JAX traces.  Name-based matching is module-wide (a local def jitted
+    two scopes away still matches); over-approximation is acceptable —
+    rules built on this are themselves conservative."""
+    scopes: dict = {}
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    def mark(node, reason, static: Set[str]):
+        if node in scopes:
+            scopes[node].static_names |= static
+        else:
+            scopes[node] = TracedScope(node, reason, set(static))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                path = imports.resolve(target)
+                if path in _TRACING_WRAPPERS:
+                    static: Set[str] = set()
+                    if isinstance(dec, ast.Call):
+                        static = _static_names_from_call(dec, node)
+                    mark(node, path, static)
+                elif (
+                    path in ("functools.partial", "partial")
+                    and isinstance(dec, ast.Call)
+                    and dec.args
+                    and imports.resolve(dec.args[0]) in _TRACING_WRAPPERS
+                ):
+                    mark(
+                        node,
+                        imports.resolve(dec.args[0]),
+                        _static_names_from_call(dec, node),
+                    )
+        elif isinstance(node, ast.Call):
+            path = imports.resolve(node.func)
+            if path not in _TRACING_WRAPPERS:
+                continue
+            for idx in _TRACING_WRAPPERS[path]:
+                if idx >= len(node.args):
+                    continue
+                arg = node.args[idx]
+                if isinstance(arg, ast.Lambda):
+                    static = (
+                        _static_names_from_call(node, arg)
+                        if path == "jax.jit"
+                        else set()
+                    )
+                    mark(arg, path, static)
+                elif isinstance(arg, ast.Name):
+                    for fn in defs_by_name.get(arg.id, []):
+                        static = (
+                            _static_names_from_call(node, fn)
+                            if path == "jax.jit"
+                            else set()
+                        )
+                        mark(fn, path, static)
+    return scopes
+
+
+def _call_path(ctx: FileContext, node: ast.AST) -> Optional[str]:
+    return ctx.imports.resolve(node)
+
+
+def _walk_scope(fn_node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested defs/lambdas
+    (they are separate scopes)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# rule: key-reuse
+# ---------------------------------------------------------------------------
+
+#: jax.random functions that CONSUME a key (same key in -> same draw out);
+#: ``split`` is included — splitting the same key twice yields identical
+#: children.  ``fold_in`` derives and is exempt unless folded with the
+#: same literal twice.
+_KEY_CONSUMERS = {
+    "ball", "bernoulli", "beta", "binomial", "bits", "categorical",
+    "cauchy", "chisquare", "choice", "dirichlet", "double_sided_maxwell",
+    "exponential", "gamma", "geometric", "gumbel", "laplace", "loggamma",
+    "logistic", "lognormal", "maxwell", "multivariate_normal", "normal",
+    "orthogonal", "pareto", "permutation", "poisson", "rademacher",
+    "randint", "rayleigh", "shuffle", "split", "t", "truncated_normal",
+    "uniform", "wald", "weibull_min",
+}
+
+_KEY_PARAM_HINT = ("key", "keys", "rng", "prng")
+
+
+def _is_key_name(name: str) -> bool:
+    low = name.lower()
+    return low in _KEY_PARAM_HINT or low.endswith("_key") or low.endswith("_rng")
+
+
+@register_rule
+class KeyReuseRule(LintRule):
+    id = "key-reuse"
+    doc = (
+        "A PRNG key variable is consumed by two `jax.random.*` draws "
+        "(including `split`) without being re-derived in between — the "
+        "second draw repeats the first's randomness bit-for-bit.  Thread "
+        "keys with `key, sub = jax.random.split(key)` or derive with "
+        "`jax.random.fold_in(key, step)`; `fold_in` with distinct data is "
+        "exempt, folding the same literal twice is flagged."
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        fns: List[ast.AST] = [ctx.tree]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns.append(node)
+        for fn in fns:
+            yield from self._check_scope(ctx, fn)
+
+    def _key_vars(self, ctx, fn) -> Set[str]:
+        """Names that plausibly hold PRNG keys in this scope: parameters
+        with key-ish names plus assignment targets of PRNGKey/split/
+        fold_in results."""
+        names: Set[str] = set()
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = fn.args
+            for arg in a.posonlyargs + a.args + a.kwonlyargs:
+                if _is_key_name(arg.arg):
+                    names.add(arg.arg)
+        for node in _walk_scope(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            path = _call_path(ctx, node.value.func) or ""
+            if path in (
+                "jax.random.PRNGKey", "jax.random.key",
+                "jax.random.split", "jax.random.fold_in",
+            ):
+                for target in node.targets:
+                    elts = (
+                        target.elts
+                        if isinstance(target, (ast.Tuple, ast.List))
+                        else [target]
+                    )
+                    for e in elts:
+                        if isinstance(e, ast.Name):
+                            names.add(e.id)
+        return names
+
+    def _check_scope(self, ctx, fn) -> Iterator[Finding]:
+        key_vars = self._key_vars(ctx, fn)
+        if not key_vars:
+            return
+        # statement-ordered linear scan: consumption marks the var dirty,
+        # any reassignment of the var resets it
+        consumed: Dict[str, int] = {}
+        fold_literals: Dict[Tuple[str, object], int] = {}
+
+        class _V(ast.NodeVisitor):
+            def __init__(self, outer):
+                self.findings: List[Finding] = []
+                self.outer = outer
+
+            def visit_FunctionDef(self, node):  # separate scope
+                return
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Lambda(self, node):
+                return
+
+            def visit_Call(self, node):
+                path = _call_path(ctx, node.func) or ""
+                if path.startswith("jax.random.") and node.args:
+                    arg0 = node.args[0]
+                    op = path.rsplit(".", 1)[1]
+                    if isinstance(arg0, ast.Name) and arg0.id in key_vars:
+                        if op in _KEY_CONSUMERS:
+                            prev = consumed.get(arg0.id)
+                            if prev is not None:
+                                self.findings.append(
+                                    self.outer.finding(
+                                        ctx, node,
+                                        f"PRNG key `{arg0.id}` consumed "
+                                        f"again by jax.random.{op} (first "
+                                        f"consumed on line {prev}) without "
+                                        "re-derivation: identical randomness",
+                                    )
+                                )
+                            else:
+                                consumed[arg0.id] = node.lineno
+                        elif op == "fold_in" and len(node.args) > 1:
+                            try:
+                                lit = ast.literal_eval(node.args[1])
+                            except (ValueError, SyntaxError):
+                                lit = None
+                            if lit is not None:
+                                k = (arg0.id, lit)
+                                prev = fold_literals.get(k)
+                                if prev is not None:
+                                    self.findings.append(
+                                        self.outer.finding(
+                                            ctx, node,
+                                            f"`fold_in({arg0.id}, {lit!r})` "
+                                            f"repeats line {prev}: both "
+                                            "derive the SAME child key",
+                                        )
+                                    )
+                                else:
+                                    fold_literals[k] = node.lineno
+                self.generic_visit(node)
+
+            def visit_Assign(self, node):
+                self.visit(node.value)  # RHS reads before LHS rebinds
+                for target in node.targets:
+                    elts = (
+                        target.elts
+                        if isinstance(target, (ast.Tuple, ast.List))
+                        else [target]
+                    )
+                    for e in elts:
+                        if isinstance(e, ast.Name):
+                            consumed.pop(e.id, None)
+                            for k in [
+                                fk for fk in fold_literals if fk[0] == e.id
+                            ]:
+                                fold_literals.pop(k, None)
+
+            def visit_If(self, node):
+                # branches are mutually exclusive draws, not reuse: run each
+                # with its own state and merge only the fall-through paths
+                self.visit(node.test)
+                base = (dict(consumed), dict(fold_literals))
+                taken = []
+                for branch in (node.body, node.orelse):
+                    consumed.clear()
+                    consumed.update(base[0])
+                    fold_literals.clear()
+                    fold_literals.update(base[1])
+                    for stmt in branch:
+                        self.visit(stmt)
+                    if not _terminates(branch):
+                        taken.append(
+                            (dict(consumed), dict(fold_literals))
+                        )
+                consumed.clear()
+                fold_literals.clear()
+                if taken:
+                    # a key counts as consumed after the If only if EVERY
+                    # fall-through branch consumed it
+                    for name in set.intersection(
+                        *[set(c) for c, _ in taken]
+                    ):
+                        consumed[name] = min(c[name] for c, _ in taken)
+                    for k in set.intersection(
+                        *[set(f) for _, f in taken]
+                    ):
+                        fold_literals[k] = min(f[k] for _, f in taken)
+
+        def _terminates(branch) -> bool:
+            return bool(branch) and isinstance(
+                branch[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
+            )
+
+        visitor = _V(self)
+        body = fn.body if hasattr(fn, "body") else []
+        for stmt in body:
+            visitor.visit(stmt)
+        yield from visitor.findings
+
+
+# ---------------------------------------------------------------------------
+# rule: traced-branch
+# ---------------------------------------------------------------------------
+
+
+@register_rule
+class TracedBranchRule(LintRule):
+    id = "traced-branch"
+    doc = (
+        "A Python `if`/`while` inside a jit/vmap/lax-traced function "
+        "branches on a NON-static parameter — at trace time the test is a "
+        "tracer, which raises `TracerBoolConversionError` at best and "
+        "silently specializes at worst.  Use `jax.lax.cond`/`jnp.where`, "
+        "or move the value to `static_argnums`.  Tests on static "
+        "attributes (`.ndim`, `.shape`, `.dtype`, `.size`, `len()`) and "
+        "`is None` checks are exempt (those are static at trace time)."
+    )
+
+    _STATIC_ATTRS = ("ndim", "shape", "dtype", "size", "aval", "sharding")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn_node, scope in ctx.traced.items():
+            if isinstance(fn_node, ast.Lambda):
+                continue  # lambdas cannot contain if/while statements
+            traced_params = set(scope.params) - scope.static_names
+            if not traced_params:
+                continue
+            for node in _walk_scope(fn_node):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                name = self._traced_name_in_test(node.test, traced_params)
+                if name:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield self.finding(
+                        ctx, node,
+                        f"Python `{kind}` on traced argument `{name}` "
+                        f"inside a {scope.reason}-traced function: the "
+                        "test is a tracer at trace time (use lax.cond/"
+                        "jnp.where or static_argnums)",
+                    )
+
+    def _traced_name_in_test(self, test, traced) -> Optional[str]:
+        # `x is None` / `x is not None`: static pytree-structure checks
+        if isinstance(test, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+        ):
+            return None
+        banned_parents: Set[int] = set()
+        for node in ast.walk(test):
+            if isinstance(node, ast.Attribute) and node.attr in self._STATIC_ATTRS:
+                for sub in ast.walk(node.value):
+                    banned_parents.add(id(sub))
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in ("len", "isinstance", "getattr", "hasattr")
+            ):
+                for sub in ast.walk(node):
+                    banned_parents.add(id(sub))
+        for node in ast.walk(test):
+            if (
+                isinstance(node, ast.Name)
+                and node.id in traced
+                and id(node) not in banned_parents
+            ):
+                return node.id
+        return None
+
+
+# ---------------------------------------------------------------------------
+# rule: static-args
+# ---------------------------------------------------------------------------
+
+
+@register_rule
+class StaticArgsRule(LintRule):
+    id = "static-args"
+    doc = (
+        "`static_argnums`/`static_argnames` declared with non-int/str "
+        "literals, or a locally-visible call that passes an array-valued "
+        "or unhashable (list/dict/set literal, `np.array(...)`, "
+        "`jnp.asarray(...)`) argument in a static position — jit hashes "
+        "static arguments, so these fail with `Non-hashable static "
+        "arguments` or, worse, retrace per call."
+    )
+
+    _ARRAY_CALLS = (
+        "numpy.array", "numpy.asarray", "numpy.zeros", "numpy.ones",
+        "jax.numpy.array", "jax.numpy.asarray", "jax.numpy.zeros",
+        "jax.numpy.ones", "jax.numpy.arange",
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        jitted_static: Dict[str, List[int]] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_path(ctx, node.func) != "jax.jit":
+                continue
+            for kw in node.keywords:
+                if kw.arg not in ("static_argnums", "static_argnames"):
+                    continue
+                want = int if kw.arg == "static_argnums" else str
+                try:
+                    val = ast.literal_eval(kw.value)
+                except (ValueError, SyntaxError):
+                    continue
+                items = val if isinstance(val, (tuple, list)) else (val,)
+                bad = [
+                    i for i in items
+                    if not isinstance(i, want) or isinstance(i, bool)
+                ]
+                if bad:
+                    yield self.finding(
+                        ctx, kw.value,
+                        f"{kw.arg} must be {want.__name__} literals; got "
+                        f"{bad!r}",
+                    )
+                elif kw.arg == "static_argnums":
+                    # remember positions for the local call-site check
+                    parent = ctx.parents.get(node)
+                    if isinstance(parent, ast.Assign):
+                        for t in parent.targets:
+                            if isinstance(t, ast.Name):
+                                jitted_static[t.id] = [
+                                    i for i in items if isinstance(i, int)
+                                ]
+        if not jitted_static:
+            return
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in jitted_static
+            ):
+                continue
+            for pos in jitted_static[node.func.id]:
+                if pos >= len(node.args):
+                    continue
+                arg = node.args[pos]
+                reason = None
+                if isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                    reason = "an unhashable container literal"
+                elif (
+                    isinstance(arg, ast.Call)
+                    and (_call_path(ctx, arg.func) or "") in self._ARRAY_CALLS
+                ):
+                    reason = "an array value"
+                if reason:
+                    yield self.finding(
+                        ctx, arg,
+                        f"argument {pos} of `{node.func.id}` is static "
+                        f"(static_argnums) but receives {reason}: jit "
+                        "hashes static args",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# rule: jit-mutable-closure
+# ---------------------------------------------------------------------------
+
+
+@register_rule
+class JitMutableClosureRule(LintRule):
+    id = "jit-mutable-closure"
+    doc = (
+        "A traced function closes over state that is mutated: a "
+        "module-level list/dict/set literal, a name `.append`/`.update`/"
+        "`.extend`-mutated or item-assigned in the enclosing scope, or a "
+        "name REBOUND after the traced def.  jit captures closures as "
+        "trace-time constants — later mutations are silently invisible "
+        "to the compiled program (stale-constant bugs)."
+    )
+
+    _MUTATORS = ("append", "extend", "update", "add", "insert", "pop",
+                 "setdefault", "clear", "remove")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        module_mutables: Set[str] = set()
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, (ast.List, ast.Dict, ast.Set)
+            ):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) and not t.id.isupper():
+                        module_mutables.add(t.id)
+        for fn_node, scope in ctx.traced.items():
+            if isinstance(fn_node, ast.Lambda):
+                continue
+            local = self._bound_names(fn_node)
+            loads = self._loaded_names(fn_node)
+            free = loads - local - set(scope.params) - set(
+                ctx.imports.aliases
+            ) - {"self", "cls"}
+            if not free:
+                continue
+            enclosing = ctx.enclosing_function(fn_node)
+            mutated = self._mutations(ctx, enclosing, fn_node)
+            for name in sorted(free):
+                if name in module_mutables:
+                    yield self.finding(
+                        ctx, fn_node,
+                        f"traced function `{getattr(fn_node, 'name', '?')}` "
+                        f"closes over module-level mutable `{name}`: jit "
+                        "freezes it at trace time",
+                    )
+                elif name in mutated:
+                    yield self.finding(
+                        ctx, fn_node,
+                        f"traced function `{getattr(fn_node, 'name', '?')}` "
+                        f"closes over `{name}`, which is "
+                        f"{mutated[name]} in the enclosing scope: the "
+                        "compiled program keeps the trace-time value",
+                    )
+
+    def _bound_names(self, fn) -> Set[str]:
+        out: Set[str] = set()
+        for node in _walk_scope(fn):
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)
+            ):
+                out.add(node.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.add(node.name)
+            elif isinstance(node, ast.comprehension):
+                for t in ast.walk(node.target):
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+    def _loaded_names(self, fn) -> Set[str]:
+        # walk the BODY only: names in the def's own decorators/argument
+        # defaults are evaluated at def time (the `body(..., t=tables)`
+        # capture-by-value idiom), not closure reads
+        out: Set[str] = set()
+        for stmt in fn.body:
+            for node in ast.walk(stmt):  # nested defs DO read the closure
+                if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load
+                ):
+                    out.add(node.id)
+        return out
+
+    def _mutations(self, ctx, enclosing, fn_node) -> Dict[str, str]:
+        """Names mutated/rebound in the enclosing function scope, with a
+        human-readable description.  Rebinds BEFORE the def are ordinary
+        setup, only later ones invalidate the captured value."""
+        out: Dict[str, str] = {}
+        if enclosing is None:
+            return out
+        def_line = fn_node.lineno
+        for node in _walk_scope(enclosing):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in self._MUTATORS
+                and isinstance(node.func.value, ast.Name)
+            ):
+                out[node.func.value.id] = (
+                    f"`.{node.func.attr}()`-mutated (line {node.lineno})"
+                )
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                if node.lineno <= def_line:
+                    continue
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Subscript) and isinstance(
+                        t.value, ast.Name
+                    ):
+                        out[t.value.id] = (
+                            f"item-assigned (line {node.lineno})"
+                        )
+                    elif isinstance(t, ast.Name) and isinstance(
+                        node, ast.AugAssign
+                    ):
+                        out[t.id] = f"rebound (line {node.lineno})"
+                    elif isinstance(t, ast.Name) and node.lineno > def_line:
+                        out.setdefault(
+                            t.id, f"rebound after the def (line {node.lineno})"
+                        )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# rule: unfenced-blocking-read
+# ---------------------------------------------------------------------------
+
+#: modules that ARE the fence implementation: reads there are the
+#: measurement, not a hazard
+_FENCE_MODULES = (
+    "spark_ensemble_tpu/telemetry/",
+    "spark_ensemble_tpu/utils/instrumentation.py",
+    "spark_ensemble_tpu/utils/profiling.py",
+)
+
+#: calls whose results live on device — wrapping them directly in a host
+#: conversion is a synchronous device->host fetch
+_DEVICE_PRODUCERS = ("predict", "predict_proba", "predict_raw")
+
+
+@register_rule
+class UnfencedBlockingReadRule(LintRule):
+    id = "unfenced-blocking-read"
+    doc = (
+        "A blocking device read — `jax.block_until_ready`, "
+        "`.block_until_ready()`, `jax.device_get`, or `np.asarray`/"
+        "`float`/`int` wrapped directly around a `.predict*()` or "
+        "`jax.random.*` result — outside a timed fence.  Unfenced reads "
+        "serialize the host against the device inside the dispatch "
+        "window, the stall the lookahead pipeline (execution.py) exists "
+        "to hide, and unmeasured ones corrupt the `host_blocked_us` "
+        "accounting.  A read is fenced when it sits between a "
+        "`t = time.perf_counter()` assignment and a "
+        "`time.perf_counter() - t` readout in the same function, inside "
+        "a `with telem.span(...)` block, or is charged via "
+        "`FitTelemetry.blocking_read`/`host_blocked`.  The telemetry and "
+        "instrumentation modules (the fence implementation) are exempt."
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        rel = ctx.relpath.replace("\\", "/")
+        if any(rel.startswith(m) or rel == m for m in _FENCE_MODULES):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            desc = self._blocking_desc(ctx, node)
+            if desc is None:
+                continue
+            if self._is_fenced(ctx, node):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"unfenced blocking device read ({desc}): wrap in a "
+                "perf_counter fence / telem.span, charge it via "
+                "FitTelemetry.blocking_read, or suppress with a reason",
+            )
+
+    def _blocking_desc(self, ctx, node: ast.Call) -> Optional[str]:
+        path = _call_path(ctx, node.func)
+        if path in ("jax.block_until_ready", "jax.device_get"):
+            return path
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "block_until_ready"
+        ):
+            return ".block_until_ready()"
+        # host conversion wrapped DIRECTLY around a device-producing call
+        if path in ("numpy.asarray", "numpy.array", "float", "int", "bool"):
+            for arg in node.args[:1]:
+                inner = arg
+                # peel one conversion layer: float(np.mean(np.asarray(...)))
+                if inner is not None and isinstance(inner, ast.Call):
+                    ipath = _call_path(ctx, inner.func) or ""
+                    if isinstance(
+                        inner.func, ast.Attribute
+                    ) and inner.func.attr in _DEVICE_PRODUCERS:
+                        return f"host conversion of `.{inner.func.attr}()`"
+                    if ipath.startswith("jax.random."):
+                        return f"host conversion of `{ipath}`"
+        return None
+
+    def _is_fenced(self, ctx, node) -> bool:
+        fn = ctx.enclosing_function(node)
+        if fn is None:
+            return False
+        # inside `with <x>.span(...)` / `with <x>.blocking_read(...)`
+        cur = ctx.parents.get(node)
+        while cur is not None and cur is not fn:
+            if isinstance(cur, ast.With):
+                for item in cur.items:
+                    e = item.context_expr
+                    if (
+                        isinstance(e, ast.Call)
+                        and isinstance(e.func, ast.Attribute)
+                        and e.func.attr in ("span", "blocking_read")
+                    ):
+                        return True
+            cur = ctx.parents.get(cur)
+        # timed fence: a perf_counter assignment at-or-above the read and
+        # a `perf_counter() - t` readout at-or-below it
+        line = node.lineno
+        starts: List[int] = []
+        ends: List[int] = []
+        charges: List[int] = []
+        for sub in _walk_scope(fn):
+            if isinstance(sub, ast.Assign) and isinstance(
+                sub.value, ast.Call
+            ):
+                if _call_path(ctx, sub.value.func) == "time.perf_counter":
+                    starts.append(sub.lineno)
+            elif isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Sub):
+                if (
+                    isinstance(sub.left, ast.Call)
+                    and _call_path(ctx, sub.left.func) == "time.perf_counter"
+                ):
+                    ends.append(sub.lineno)
+            elif isinstance(sub, ast.Call) and isinstance(
+                sub.func, ast.Attribute
+            ):
+                # a telem.blocking_read/host_blocked/round_chunk(fence=...)
+                # call: the wait is charged there, so reads BELOW it touch
+                # already-fenced arrays and do not block
+                if sub.func.attr in (
+                    "blocking_read", "host_blocked", "round_chunk"
+                ):
+                    charges.append(sub.lineno)
+        if any(c <= line for c in charges):
+            return True
+        return any(s <= line for s in starts) and any(
+            e >= line for e in ends
+        )
+
+
+# ---------------------------------------------------------------------------
+# rule: f64-upcast
+# ---------------------------------------------------------------------------
+
+
+@register_rule
+class F64UpcastRule(LintRule):
+    id = "f64-upcast"
+    doc = (
+        "An explicit float64 on the device path — `jnp.float64`, a jnp "
+        "constructor with `dtype` float64/'float64', `.astype(jnp."
+        "float64)`, or `jax.config.update('jax_enable_x64', True)` — "
+        "violating the package's f32 dtype policy (every kernel, packed "
+        "model and histogram is f32; a single f64 literal silently "
+        "doubles bandwidth or fails under the default x64-disabled "
+        "config).  Host-side `np.float64` accounting is exempt."
+    )
+
+    _JNP_CONSTRUCTORS = (
+        "jax.numpy.array", "jax.numpy.asarray", "jax.numpy.zeros",
+        "jax.numpy.ones", "jax.numpy.full", "jax.numpy.arange",
+        "jax.numpy.linspace", "jax.numpy.empty",
+    )
+
+    def _is_f64(self, ctx, node) -> bool:
+        if isinstance(node, ast.Constant) and node.value in (
+            "float64", "f64", "double"
+        ):
+            return True
+        path = _call_path(ctx, node)
+        return path in ("jax.numpy.float64", "numpy.float64") and (
+            path == "jax.numpy.float64"
+        )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            path = _call_path(ctx, node) if isinstance(
+                node, (ast.Attribute, ast.Name)
+            ) else None
+            if path == "jax.numpy.float64":
+                parent = ctx.parents.get(node)
+                yield self.finding(
+                    ctx, parent if parent is not None else node,
+                    "`jnp.float64` violates the f32 dtype policy "
+                    "(docs/overview.md): device arrays are f32 end-to-end",
+                )
+            if not isinstance(node, ast.Call):
+                continue
+            cpath = _call_path(ctx, node.func) or ""
+            if cpath in self._JNP_CONSTRUCTORS:
+                for kw in node.keywords:
+                    if kw.arg == "dtype" and self._is_f64(ctx, kw.value):
+                        yield self.finding(
+                            ctx, node,
+                            f"`{cpath.replace('jax.numpy', 'jnp')}` with a "
+                            "float64 dtype: f32 policy violation",
+                        )
+                for arg in node.args[1:]:
+                    if self._is_f64(ctx, arg):
+                        yield self.finding(
+                            ctx, node,
+                            f"`{cpath.replace('jax.numpy', 'jnp')}` with a "
+                            "float64 dtype: f32 policy violation",
+                        )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"
+                and node.args
+                and self._is_f64(ctx, node.args[0])
+            ):
+                yield self.finding(
+                    ctx, node,
+                    "`.astype(float64)` on the device path: f32 policy "
+                    "violation",
+                )
+            elif (
+                cpath == "jax.config.update"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == "jax_enable_x64"
+                and len(node.args) > 1
+                and isinstance(node.args[1], ast.Constant)
+                and node.args[1].value
+            ):
+                yield self.finding(
+                    ctx, node,
+                    "enabling jax_enable_x64 flips every default dtype to "
+                    "f64: forbidden by the f32 policy",
+                )
+
+
+# ---------------------------------------------------------------------------
+# rule: host-call-in-jit
+# ---------------------------------------------------------------------------
+
+
+@register_rule
+class HostCallInJitRule(LintRule):
+    id = "host-call-in-jit"
+    doc = (
+        "A host-side call — `time.time`/`time.perf_counter`, "
+        "`np.random.*`, stdlib `random.*`, `os.environ` reads, `print`, "
+        "`datetime.now` — inside a traced function.  These execute ONCE "
+        "at trace time and bake their value into the compiled program: a "
+        "timestamp never advances, 'randomness' repeats per call, env "
+        "flips are ignored.  Resolve host values before the jit boundary "
+        "and pass them as arguments (jax.debug.print is the traced-safe "
+        "print)."
+    )
+
+    _BANNED_PREFIXES = (
+        "time.", "numpy.random.", "random.", "os.environ", "os.getenv",
+        "datetime.",
+    )
+    _BANNED_EXACT = ("print", "input", "open")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn_node, scope in ctx.traced.items():
+            for node in ast.walk(fn_node):
+                if not isinstance(node, ast.Call):
+                    continue
+                path = _call_path(ctx, node.func) or ""
+                hit = None
+                if path in self._BANNED_EXACT:
+                    hit = path
+                else:
+                    for pre in self._BANNED_PREFIXES:
+                        if path.startswith(pre):
+                            hit = path
+                            break
+                if hit:
+                    yield self.finding(
+                        ctx, node,
+                        f"host call `{hit}` inside a {scope.reason}-traced "
+                        "function runs ONCE at trace time (its result is a "
+                        "baked-in constant); hoist it out of the traced "
+                        "scope",
+                    )

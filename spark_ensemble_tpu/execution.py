@@ -120,19 +120,22 @@ def _patience_scan_program():
 
 
 def device_patience_step(
-    errs, best: float, v: int, tol: float, limit: int
+    errs, best: float, v: int, tol: float, limit: int, telem=None
 ) -> Tuple[float, int, bool, int]:
     """Fold a chunk's per-round validation losses on-device and read back
     four scalars: ``(best, v, stopped, kept)`` where ``kept`` counts the
     rounds up to AND INCLUDING the stopping round.  ``best`` comes back
     as float32 — callers carrying it across chunks stay in the device's
-    precision by construction."""
+    precision by construction.
+
+    The four-scalar readback is a blocking host read inside the dispatch
+    window; with ``telem`` it is charged to the fit's ``host_blocked_us``
+    accounting like every other sanctioned fence (graftlint
+    unfenced-blocking-read)."""
     prog = _patience_scan_program()
     b0 = np.float32(np.inf) if not np.isfinite(best) else np.float32(best)
-    best_a, v_a, done_a, kept_a = prog(
-        errs, b0, np.int32(v), np.float32(tol), np.int32(limit)
-    )
-    best_h, v_h, done_h, kept_h = jax.device_get(
-        (best_a, v_a, done_a, kept_a)
-    )
+    out = prog(errs, b0, np.int32(v), np.float32(tol), np.int32(limit))
+    if telem is not None:
+        telem.blocking_read(out)
+    best_h, v_h, done_h, kept_h = jax.device_get(out)
     return float(best_h), int(v_h), bool(done_h), int(kept_h)

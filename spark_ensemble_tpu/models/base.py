@@ -84,6 +84,65 @@ _PROGRAM_CACHE: "OrderedDict[tuple, Callable]" = OrderedDict()
 _PROGRAM_CACHE_SIZE = 128
 _PROGRAM_CACHE_LOCK = threading.Lock()
 
+# Program-call observers (analysis/contracts.py): while any observer is
+# registered, every program handed out by `cached_program` (and every
+# `_predict_program` dispatch) is wrapped so each CALL reports its tag and
+# abstract argument signature.  Counting distinct (tag, signature) pairs is
+# how the contract checker pins compile budgets *independently of cache
+# warmth*: a cache hit, a persistent-compile-cache hit, and a chaos-retry
+# replay all re-call the same signature and count once.  The wrapper is
+# never stored in the cache — a later unobserved caller gets the raw fn.
+_PROGRAM_OBSERVERS: list = []
+
+
+def observe_program_calls(callback):
+    """Context manager registering ``callback(tag, signature, fn, args,
+    kwargs)`` for every cached-program / predict-program call in the
+    enclosed scope.  ``fn`` is the underlying jitted callable (so the
+    observer can abstractly re-trace it); observers must be thread-safe —
+    stacking fits members concurrently."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _scope():
+        _PROGRAM_OBSERVERS.append(callback)
+        try:
+            yield
+        finally:
+            _PROGRAM_OBSERVERS.remove(callback)
+
+    return _scope()
+
+
+def _aval_signature(args, kwargs=None) -> tuple:
+    """Abstract (shape, dtype) signature of a call's arguments — the same
+    information jit keys its trace cache on, minus weak types."""
+    sig = []
+    leaves = list(jax.tree_util.tree_leaves(args))
+    if kwargs:
+        leaves += jax.tree_util.tree_leaves(kwargs)
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            sig.append((tuple(shape), str(getattr(leaf, "dtype", ""))))
+        else:
+            sig.append((type(leaf).__name__, repr(leaf)[:48]))
+    return tuple(sig)
+
+
+def _maybe_observed(key: tuple, fn: Callable) -> Callable:
+    if not _PROGRAM_OBSERVERS:
+        return fn
+    tag = key[0] if key and isinstance(key[0], str) else repr(key[:1])
+
+    def observed(*args, **kwargs):
+        sig = _aval_signature(args, kwargs)
+        for cb in list(_PROGRAM_OBSERVERS):
+            cb(tag, sig, fn, args, kwargs)
+        return fn(*args, **kwargs)
+
+    return observed
+
 
 def cached_program(key: tuple, build: Callable[[], Callable]) -> Callable:
     """Return the jitted program for ``key``, building it on first use.
@@ -112,18 +171,18 @@ def cached_program(key: tuple, build: Callable[[], Callable]) -> Callable:
         fn = _PROGRAM_CACHE.get(key)
         if fn is not None:
             _PROGRAM_CACHE.move_to_end(key)
-            return fn
+            return _maybe_observed(key, fn)
     fn = build()
     with _PROGRAM_CACHE_LOCK:
         existing = _PROGRAM_CACHE.get(key)
         if existing is not None:
             # lost a build race: keep the winner, but refresh its LRU slot
             _PROGRAM_CACHE.move_to_end(key)
-            return existing
+            return _maybe_observed(key, existing)
         _PROGRAM_CACHE[key] = fn
         while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_SIZE:
             _PROGRAM_CACHE.popitem(last=False)
-    return fn
+    return _maybe_observed(key, fn)
 
 
 # ---------------------------------------------------------------------------
@@ -441,7 +500,7 @@ class Model(Params):
         predict ops are row-independent, so the real rows' values are
         bit-identical to an unpadded call; ``out_row_axis`` names the output
         axis that carries rows (1 for ``[members, n]`` member stacks)."""
-        fn = self._cached_jit(name, builder)
+        fn = _maybe_observed((f"predict:{name}",), self._cached_jit(name, builder))
         n = np.shape(X)[0]
         if not predict_buckets_enabled() or bucket_rows(n) == n:
             return fn(*args, as_f32(X))

@@ -391,7 +391,8 @@ class _GBMParams(CheckpointableParams, Estimator):
                     # chunk instead of stepping the loop per round (the
                     # per-round log lines are skipped in this mode)
                     best, v, stopped, kept = _execution.device_patience_step(
-                        errs, best, v, self.validation_tol, self.num_rounds
+                        errs, best, v, self.validation_tol, self.num_rounds,
+                        telem=telem,
                     )
                     if val_history is not None:
                         val_history.extend(
@@ -697,6 +698,7 @@ def _probe_classifier_phases(
 
     def time_once(fn, *args):
         out = fn(*args)  # compile + warmup execution
+        # graftlint: ignore[unfenced-blocking-read] -- warmup sync before the timed rep, deliberately untimed
         jax.block_until_ready(out)
         t0 = time.perf_counter()
         out = fn(*args)

@@ -69,6 +69,7 @@ class ParamGridBuilder:
 
 def _kfold_indices(n: int, num_folds: int, seed: int) -> List[np.ndarray]:
     """Shuffled, near-equal fold membership arrays (bool[n] per fold)."""
+    # graftlint: ignore[unfenced-blocking-read] -- one-off fold-plan setup read before any fit dispatch
     perm = np.asarray(jax.random.permutation(jax.random.PRNGKey(seed), n))
     folds = []
     for f in range(num_folds):
@@ -251,6 +252,7 @@ class TrainValidationSplit(_TuningParams):
         evaluator: Evaluator = self.evaluator
         maps = self._maps()
         n = X.shape[0]
+        # graftlint: ignore[unfenced-blocking-read] -- one-off split-plan setup read before any fit dispatch
         perm = np.asarray(jax.random.permutation(jax.random.PRNGKey(self.seed), n))
         n_train = int(n * self.train_ratio)
         train_mask = np.zeros((n,), bool)

@@ -1,0 +1,8 @@
+"""Positive fixture: explicit float64 on the device path."""
+
+import jax
+import jax.numpy as jnp
+
+x = jnp.zeros((4,), dtype=jnp.float64)  # f64 constructor dtype: flagged
+y = x.astype("float64")  # f64 astype: flagged
+jax.config.update("jax_enable_x64", True)  # global x64 flip: flagged

@@ -1,0 +1,8 @@
+"""Negative fixture: f32 device arrays; host-side np.float64 accounting."""
+
+import jax.numpy as jnp
+import numpy as np
+
+x = jnp.zeros((4,), dtype=jnp.float32)
+y = x.astype(jnp.float32)
+acc = np.float64(0.0)  # host accounting, not a device value

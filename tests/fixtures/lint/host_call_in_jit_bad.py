@@ -1,0 +1,12 @@
+"""Positive fixture: a wall-clock read inside a jitted function — it runs
+once at trace time and becomes a constant."""
+
+import time
+
+import jax
+
+
+@jax.jit
+def step(x):
+    t = time.time()  # baked in at trace time: flagged
+    return x + t

@@ -1,0 +1,17 @@
+"""Negative fixture: host values resolved OUTSIDE the traced scope."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    return jnp.sin(x)
+
+
+def timed_apply(fn, x):
+    t0 = time.time()  # untraced caller: fine
+    out = jax.jit(fn)(x)
+    return out, time.time() - t0

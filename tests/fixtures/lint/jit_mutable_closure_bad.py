@@ -1,0 +1,11 @@
+"""Positive fixture: a jitted function closing over module-level mutable
+state — jit bakes the trace-time value in."""
+
+import jax
+
+tables = []
+
+
+@jax.jit
+def forward(x):
+    return x + len(tables)  # `tables` frozen at trace time: flagged

@@ -1,0 +1,20 @@
+"""Negative fixture: constants and the default-arg capture-by-value idiom."""
+
+import jax
+
+SIZES = (4, 8)  # UPPER + immutable: a deliberate constant
+
+
+@jax.jit
+def forward(x):
+    return x + len(SIZES)
+
+
+def outer(tables):
+    # `tables=tables` evaluates at def time: capture by VALUE, not closure
+    @jax.jit
+    def inner(x, tables=tables):
+        return x + len(tables)
+
+    tables = None  # rebinding the outer name cannot affect `inner`
+    return inner

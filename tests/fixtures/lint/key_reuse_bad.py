@@ -1,0 +1,9 @@
+"""Positive fixture: the same key feeds two draws — identical randomness."""
+
+import jax
+
+
+def draw(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))  # reuses `key`: flagged
+    return a + b

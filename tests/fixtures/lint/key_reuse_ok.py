@@ -1,0 +1,18 @@
+"""Negative fixture: keys threaded through split; branch-exclusive draws."""
+
+import jax
+
+
+def draw(key):
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, (4,))
+    key, sub2 = jax.random.split(key)
+    b = jax.random.uniform(sub2, (4,))
+    return a + b
+
+
+def branchy(key, replacement):
+    # mutually exclusive draws from the same key are NOT reuse
+    if replacement:
+        return jax.random.poisson(key, 1.0, (4,))
+    return jax.random.bernoulli(key, 0.5, (4,))

@@ -1,0 +1,13 @@
+"""Positive fixture: unhashable values in static argument positions."""
+
+import jax
+
+
+def body(x, cfg):
+    return x * len(cfg)
+
+
+jitted = jax.jit(body, static_argnums=(1,))
+out = jitted(1.0, [1, 2, 3])  # list literal in a static slot: flagged
+
+misdeclared = jax.jit(body, static_argnums=("cfg",))  # str in argNUMS: flagged

@@ -1,0 +1,13 @@
+"""Negative fixture: hashable static arguments, correctly declared."""
+
+import jax
+
+
+def body(x, n):
+    return x * n
+
+
+jitted = jax.jit(body, static_argnums=(1,))
+out = jitted(1.0, 3)
+
+named = jax.jit(body, static_argnames=("n",))

@@ -1,0 +1,10 @@
+"""Positive fixture: Python `if` on a traced argument inside jit."""
+
+import jax
+
+
+@jax.jit
+def clamp(x, lo):
+    if x > lo:  # `x` is a tracer here: flagged
+        return lo
+    return x

@@ -1,0 +1,18 @@
+"""Negative fixture: static-attribute and `is None` tests are trace-safe."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def flatten(x, lo):
+    if x.ndim > 1:  # .ndim is static at trace time
+        x = x.reshape(-1)
+    return jnp.minimum(x, lo)
+
+
+@jax.jit
+def add_opt(x, y=None):
+    if y is None:  # pytree-structure check, static
+        return x
+    return x + y

@@ -1,0 +1,8 @@
+"""Positive fixture: a blocking device read with no timed fence around it."""
+
+import jax
+
+
+def run(model, X):
+    out = model.predict(X)
+    return jax.block_until_ready(out)  # unfenced host stall: flagged

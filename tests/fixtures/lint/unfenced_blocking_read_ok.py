@@ -1,0 +1,19 @@
+"""Negative fixture: reads inside a perf_counter fence or after a telemetry
+charge are measured, not hazards."""
+
+import time
+
+import numpy as np
+
+
+def timed(model, X):
+    t0 = time.perf_counter()
+    out = np.asarray(model.predict(X))
+    wall = time.perf_counter() - t0
+    return out, wall
+
+
+def charged(model, X, telem):
+    telem.blocking_read(model.predict(X))
+    # arrays were fenced-and-charged above; this conversion cannot block
+    return np.asarray(model.predict(X))

@@ -1,0 +1,238 @@
+"""graftlint tier 1 + contract-logic tests (fast: no model fits here).
+
+Every registered rule has a positive/negative fixture pair under
+``tests/fixtures/lint/`` (``<id with _>_bad.py`` / ``_ok.py``); the
+tier-2 tests that run REAL traces live in ``test_graftlint_contracts.py``
+(slow tier).
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from spark_ensemble_tpu.analysis import all_rules, lint_file, lint_paths
+from spark_ensemble_tpu.analysis import contracts as contracts_mod
+from spark_ensemble_tpu.analysis.cli import main as graftlint_main
+from spark_ensemble_tpu.analysis.lint import write_jsonl
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "lint")
+RULE_IDS = sorted(all_rules())
+
+
+def _unsuppressed(findings, rule):
+    return [f for f in findings if f.rule == rule and not f.suppressed]
+
+
+def _fixture(rule_id, kind):
+    return os.path.join(FIXTURES, f"{rule_id.replace('-', '_')}_{kind}.py")
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_fixture_pair_exists(rule_id):
+    assert os.path.exists(_fixture(rule_id, "bad")), rule_id
+    assert os.path.exists(_fixture(rule_id, "ok")), rule_id
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_fires_on_bad_fixture(rule_id):
+    findings = lint_file(_fixture(rule_id, "bad"), select=[rule_id])
+    assert _unsuppressed(findings, rule_id), (
+        f"{rule_id} missed its positive fixture"
+    )
+    for f in _unsuppressed(findings, rule_id):
+        assert f.line > 0 and f.message
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+def test_rule_quiet_on_ok_fixture(rule_id):
+    findings = lint_file(_fixture(rule_id, "ok"), select=[rule_id])
+    assert not _unsuppressed(findings, rule_id), [
+        f.to_record() for f in _unsuppressed(findings, rule_id)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# suppression semantics
+# ---------------------------------------------------------------------------
+
+_READ_SRC = textwrap.dedent(
+    """\
+    import jax
+
+
+    def run(model, X):
+        out = model.predict(X)
+        return jax.block_until_ready(out){trailing}
+    """
+)
+
+
+def test_justified_trailing_suppression(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(
+        _READ_SRC.format(
+            trailing="  # graftlint: ignore[unfenced-blocking-read]"
+            " -- test fixture reason"
+        )
+    )
+    findings = lint_file(str(p))
+    hits = [f for f in findings if f.rule == "unfenced-blocking-read"]
+    assert hits and all(f.suppressed for f in hits)
+    assert hits[0].justification == "test fixture reason"
+
+
+def test_justified_full_line_suppression(tmp_path):
+    p = tmp_path / "mod.py"
+    src = _READ_SRC.format(trailing="").replace(
+        "    return jax.block_until_ready(out)",
+        "    # graftlint: ignore[unfenced-blocking-read] -- above-line form\n"
+        "    return jax.block_until_ready(out)",
+    )
+    p.write_text(src)
+    findings = lint_file(str(p))
+    hits = [f for f in findings if f.rule == "unfenced-blocking-read"]
+    assert hits and all(f.suppressed for f in hits)
+
+
+def test_bare_suppression_suppresses_nothing(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(
+        _READ_SRC.format(
+            trailing="  # graftlint: ignore[unfenced-blocking-read]"
+        )
+    )
+    findings = lint_file(str(p))
+    # the original finding survives unsuppressed...
+    assert _unsuppressed(findings, "unfenced-blocking-read")
+    # ...and the bare ignore is itself a finding
+    meta = [f for f in findings if f.rule == "suppression-missing-reason"]
+    assert meta and not meta[0].suppressed
+
+
+def test_meta_rule_cannot_be_suppressed(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "# graftlint: ignore[suppression-missing-reason] -- nice try\n"
+        "x = 1  # graftlint: ignore[unfenced-blocking-read]\n"
+    )
+    findings = lint_file(str(p))
+    meta = [f for f in findings if f.rule == "suppression-missing-reason"]
+    assert meta and not any(f.suppressed for f in meta)
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    findings = lint_file(str(p))
+    assert [f.rule for f in findings] == ["syntax-error"]
+
+
+# ---------------------------------------------------------------------------
+# the repo itself gates clean
+# ---------------------------------------------------------------------------
+
+
+def test_repo_lints_clean():
+    findings = lint_paths()
+    loud = [f.to_record() for f in findings if not f.suppressed]
+    assert not loud, loud
+    # every suppression in the repo carries a justification (the engine
+    # refuses to honor bare ignores, so this is structural — but pin it)
+    assert all(f.justification for f in findings if f.suppressed)
+
+
+# ---------------------------------------------------------------------------
+# JSONL + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_record_shape(tmp_path):
+    findings = lint_file(_fixture("key-reuse", "bad"))
+    out = tmp_path / "findings.jsonl"
+    write_jsonl(findings, str(out))
+    records = [json.loads(line) for line in out.read_text().splitlines()]
+    assert records
+    for rec in records:
+        assert rec["event"] == "lint_finding"
+        assert {"rule", "file", "line", "col", "message", "suppressed"} <= set(
+            rec
+        )
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    assert graftlint_main([_fixture("f64-upcast", "bad")]) == 1
+    assert graftlint_main([_fixture("f64-upcast", "ok")]) == 0
+    assert graftlint_main(["--list-rules"]) == 0
+    listing = capsys.readouterr().out
+    for rule_id in RULE_IDS:
+        assert rule_id in listing
+
+
+def test_cli_writes_jsonl(tmp_path):
+    out = tmp_path / "lint.jsonl"
+    rc = graftlint_main(
+        [_fixture("host-call-in-jit", "bad"), "--jsonl", str(out)]
+    )
+    assert rc == 1
+    records = [json.loads(line) for line in out.read_text().splitlines()]
+    assert any(r["rule"] == "host-call-in-jit" for r in records)
+
+
+# ---------------------------------------------------------------------------
+# contract logic (the failing-then-fixed demo; real traces are slow-tier)
+# ---------------------------------------------------------------------------
+
+
+def test_committed_baseline_wellformed():
+    base = contracts_mod.load_baseline()
+    assert base is not None, "analysis/contracts.json must be committed"
+    assert base["version"] == 1
+    eps = base["entry_points"]
+    for fam in ("gbm", "boosting", "bagging", "stacking"):
+        assert f"{fam}_regressor.fit" in eps
+        assert f"{fam}_classifier.fit" in eps
+        assert f"{fam}_regressor.predict" in eps
+        assert f"{fam}_classifier.predict_proba" in eps
+    assert "serving.warmup" in eps
+    assert all(isinstance(v, int) and v >= 0 for v in eps.values())
+
+
+def test_budget_drift_fails_then_fixed():
+    pin = {"version": 1, "entry_points": {"gbm_regressor.fit": 3}}
+    # FAILING: the traced budget drifted off the pin
+    drifted = contracts_mod.ContractReport(budgets={"gbm_regressor.fit": 99})
+    out = contracts_mod.check_contracts(baseline=pin, report=drifted)
+    assert not out.ok
+    assert any(
+        v.contract == "budget" and "99" in v.message for v in out.violations
+    )
+    # FIXED: the same entry point back at its pinned budget is clean
+    healthy = contracts_mod.ContractReport(budgets={"gbm_regressor.fit": 3})
+    assert contracts_mod.check_contracts(baseline=pin, report=healthy).ok
+
+
+def test_unpinned_entry_point_is_a_violation():
+    rep = contracts_mod.ContractReport(budgets={"new_family.fit": 1})
+    out = contracts_mod.check_contracts(
+        baseline={"version": 1, "entry_points": {}}, report=rep
+    )
+    assert not out.ok
+    assert "--update-baseline" in out.violations[0].message
+
+
+def test_violation_record_shape():
+    v = contracts_mod.ContractViolation("budget", "gbm_regressor.fit", "msg")
+    rec = v.to_record()
+    assert rec == {
+        "event": "contract_violation",
+        "contract": "budget",
+        "entry_point": "gbm_regressor.fit",
+        "message": "msg",
+    }

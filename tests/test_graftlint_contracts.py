@@ -1,0 +1,90 @@
+"""graftlint tier 2: REAL traced program contracts (slow tier).
+
+One full contract trace per module — every family fits and predicts on
+the canonical shape classes with the program observer registered, the
+serving engine warms — then every assertion reads off that one report.
+"""
+
+import pytest
+
+from spark_ensemble_tpu.analysis import contracts as contracts_mod
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def traced():
+    return contracts_mod.trace_contracts()
+
+
+def _copy(report):
+    # check_contracts appends to the report it is given; keep the
+    # module-scoped trace pristine across tests
+    return contracts_mod.ContractReport(
+        budgets=dict(report.budgets),
+        violations=list(report.violations),
+        skipped=dict(report.skipped),
+    )
+
+
+def test_intrinsic_contracts_hold(traced):
+    # no f64, no host callbacks in round loops, no off-mesh axis names,
+    # serving warmup exact and steady-state compile-free
+    assert not traced.violations, [v.to_record() for v in traced.violations]
+
+
+def test_committed_baseline_matches_fresh_trace(traced):
+    base = contracts_mod.load_baseline()
+    assert base is not None, "analysis/contracts.json must be committed"
+    assert traced.baseline() == base, (
+        "compile budgets drifted from analysis/contracts.json; if the "
+        "change is intentional re-pin with "
+        "`python tools/graftlint.py --update-baseline` and review the diff"
+    )
+
+
+def test_check_contracts_clean_against_committed(traced):
+    report = contracts_mod.check_contracts(report=_copy(traced))
+    assert report.ok, [v.to_record() for v in report.violations]
+
+
+def test_corrupted_baseline_fails_then_committed_fixes(traced):
+    base = contracts_mod.load_baseline()
+    corrupted = {
+        "version": 1,
+        "entry_points": dict(
+            base["entry_points"], **{"gbm_regressor.fit": base[
+                "entry_points"]["gbm_regressor.fit"] + 1}
+        ),
+    }
+    broken = contracts_mod.check_contracts(
+        baseline=corrupted, report=_copy(traced)
+    )
+    assert any(
+        v.contract == "budget" and v.entry_point == "gbm_regressor.fit"
+        for v in broken.violations
+    )
+    assert contracts_mod.check_contracts(
+        baseline=base, report=_copy(traced)
+    ).ok
+
+
+@pytest.mark.parametrize(
+    "family", ["gbm", "boosting", "bagging", "stacking"]
+)
+def test_family_budgets_traced(traced, family):
+    assert f"{family}_regressor.fit" in traced.budgets
+    assert f"{family}_regressor.predict" in traced.budgets
+    assert f"{family}_classifier.fit" in traced.budgets
+    assert f"{family}_classifier.predict_proba" in traced.budgets
+
+
+def test_serving_warmup_budget(traced):
+    # one method x the bucket ladder; the exact value is pinned in the
+    # baseline (asserted above) — here pin the invariant that warmup
+    # compiled SOMETHING and the donation check ran or was skipped on cpu
+    assert traced.budgets["serving.warmup"] >= 1
+    import jax
+
+    if jax.default_backend() == "cpu":
+        assert "serving.donation" in traced.skipped

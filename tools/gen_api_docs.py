@@ -129,11 +129,22 @@ def generate(out_dir: str) -> dict:
     for module in by_module:
         counts[_basename(module)] = counts.get(_basename(module), 0) + 1
     groups: dict = {}
+    page_owner: dict = {}
     for module, entries in by_module.items():
         page = _basename(module)
         if counts[page] > 1:
             parts = module.split(".")
             page = f"{parts[-2]}_{page}" if len(parts) > 1 else page
+        # parent-qualification must actually disambiguate: a residual
+        # collision (two modules still mapping to one page id) would merge
+        # unrelated pages silently — fail generation instead
+        owner = page_owner.setdefault(page, module)
+        if owner != module:
+            raise SystemExit(
+                f"api page collision: modules {owner!r} and {module!r} "
+                f"both map to page {page!r}; rename one or deepen the "
+                "qualification in tools/gen_api_docs.py"
+            )
         groups.setdefault(page, []).extend(entries)
 
     os.makedirs(out_dir, exist_ok=True)
@@ -181,7 +192,10 @@ def generate(out_dir: str) -> dict:
         "compilation cache) has a guide at [autotune](../autotune.md).  "
         "The lookahead dispatch pipeline (`SE_TPU_PIPELINE`, on-device "
         "patience, the `host_blocked_us` metric) has a guide at "
-        "[pipeline](../pipeline.md).",
+        "[pipeline](../pipeline.md).  Static analysis (the `graftlint` "
+        "rule catalogue, suppression syntax, traced program contracts and "
+        "the compile-budget baseline) has a guide at "
+        "[static_analysis](../static_analysis.md).",
         "",
     ]
     for page, entries in sorted(groups.items()):

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Repo entry point for graftlint (docs/static_analysis.md).
+
+Thin wrapper so `python tools/graftlint.py` works from a checkout
+without installation; the installed console script (`graftlint`, see
+pyproject.toml) routes to the same `spark_ensemble_tpu.analysis.cli`.
+
+    python tools/graftlint.py                  # tier-1 lint, repo targets
+    python tools/graftlint.py --contracts      # + tier-2 traced contracts
+    python tools/graftlint.py --update-baseline
+    python tools/graftlint.py --list-rules
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spark_ensemble_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,10 +57,12 @@ def main() -> int:
     # ---- predict path (the second headline metric): steady-state reps ----
     pred_dir = trace_dir + "_predict"
     Xd = jax.numpy.asarray(X)
-    jax.block_until_ready(model.predict(Xd))  # compile outside the trace
+    # graftlint: ignore[unfenced-blocking-read] -- warmup compile outside the profiler trace, deliberately unmeasured
+    jax.block_until_ready(model.predict(Xd))
     with jax.profiler.trace(pred_dir):
         for _ in range(10):
             out = model.predict(Xd)
+        # graftlint: ignore[unfenced-blocking-read] -- end-of-trace sync: the profiler, not the host accounting, owns this window
         jax.block_until_ready(out)
     print(f"\n# predict trace (10 reps, n={n})\n")
     if not profiling.find_trace_files(pred_dir):
