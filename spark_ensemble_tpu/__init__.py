@@ -132,8 +132,15 @@ from spark_ensemble_tpu.analysis import (
     trace_contracts,
 )
 from spark_ensemble_tpu.execution import (
+    RoundExecutor,
     device_patience_enabled,
     resolve_pipeline_depth,
+)
+from spark_ensemble_tpu import data
+from spark_ensemble_tpu.data import (
+    ShardPrefetcher,
+    ShardStore,
+    write_shards,
 )
 from spark_ensemble_tpu.models.base import shared_fit_context
 from spark_ensemble_tpu.utils.persist import load
@@ -215,6 +222,10 @@ __all__ = [
     "run_search",
     "resolve_pipeline_depth",
     "device_patience_enabled",
+    "RoundExecutor",
+    "ShardStore",
+    "ShardPrefetcher",
+    "write_shards",
     "shared_fit_context",
     "lint_paths",
     "ContractReport",

@@ -482,6 +482,82 @@ def _trace_serving(report: ContractReport) -> None:
         engine.stop()
 
 
+def _trace_streaming(report: ContractReport) -> None:
+    """Trace the out-of-core streaming fit entry points (data/streaming.py).
+
+    The steady-state contract: a streaming fit dispatches a FIXED set of
+    cached programs regardless of how many shards the store holds — every
+    shard is addressed through a traced index (lax.dynamic_index_in_dim),
+    so sweeping more shards re-enters the same compiled accumulation
+    programs instead of tracing new ones.  Each family is traced at two
+    shard counts: the distinct-program count pins the ``.fit_streaming``
+    budget, and any growth between the two is flagged as a ``streaming``
+    violation (a per-shard retrace would re-serialize the sweep behind
+    the compiler)."""
+    import tempfile
+
+    from spark_ensemble_tpu.data import write_shards
+    from spark_ensemble_tpu.models.base import observe_program_calls
+
+    import spark_ensemble_tpu as se
+
+    for name, classification in (
+        ("gbm_regressor", False),
+        ("gbm_classifier", True),
+    ):
+        X, y = _canonical_data(classification)
+        entry = f"{name}.fit_streaming"
+        counts: Dict[int, int] = {}
+        failed = False
+        for shard_rows in (32, 16):  # _N=64 rows -> 2 shards, then 4
+            with tempfile.TemporaryDirectory(
+                prefix="graftlint-shards-"
+            ) as tmp:
+                store = write_shards(
+                    X,
+                    os.path.join(tmp, "store"),
+                    max_bins=64,
+                    shard_rows=shard_rows,
+                )
+                est_cls = (
+                    se.GBMClassifier if classification else se.GBMRegressor
+                )
+                est = est_cls(
+                    base_learner=se.DecisionTreeRegressor(max_depth=3),
+                    num_base_learners=3,
+                    seed=0,
+                )
+                rec = _ProgramRecorder()
+                try:
+                    with observe_program_calls(rec):
+                        est.fit_streaming(store, y)
+                except Exception as e:  # noqa: BLE001
+                    report.skipped[entry] = (
+                        f"streaming fit not traceable: {e!r:.120}"
+                    )
+                    failed = True
+                    break
+                counts[store.num_shards] = rec.count()
+                for (tag, _), jaxpr in rec.programs.items():
+                    if jaxpr is not None:
+                        _check_jaxpr(entry, tag, jaxpr, report.violations)
+        if failed:
+            continue
+        (s_a, count_a), (s_b, count_b) = sorted(counts.items())
+        report.budgets[entry] = count_a
+        if count_a != count_b:
+            report.violations.append(
+                ContractViolation(
+                    "streaming",
+                    entry,
+                    f"program count grew with shard count ({s_a} shards: "
+                    f"{count_a} programs, {s_b} shards: {count_b}): the "
+                    "shard sweep must reuse one compiled program set per "
+                    "level, not trace per shard",
+                )
+            )
+
+
 def trace_contracts(
     entry_points: Optional[List[str]] = None,
 ) -> ContractReport:
@@ -500,6 +576,8 @@ def trace_contracts(
             _trace_family(name, spec, report)
         if wanted is None or "serving" in wanted:
             _trace_serving(report)
+        if wanted is None or "streaming" in wanted:
+            _trace_streaming(report)
     return report
 
 

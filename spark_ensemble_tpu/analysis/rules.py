@@ -713,9 +713,11 @@ class UnfencedBlockingReadRule(LintRule):
     id = "unfenced-blocking-read"
     doc = (
         "A blocking device read — `jax.block_until_ready`, "
-        "`.block_until_ready()`, `jax.device_get`, or `np.asarray`/"
-        "`float`/`int` wrapped directly around a `.predict*()` or "
-        "`jax.random.*` result — outside a timed fence.  Unfenced reads "
+        "`.block_until_ready()`, `jax.device_get`, a bare concurrent-"
+        "futures `.result()` join (the data plane's shard waits), or "
+        "`np.asarray`/`float`/`int` wrapped directly around a "
+        "`.predict*()` or `jax.random.*` result — outside a timed "
+        "fence.  Unfenced reads "
         "serialize the host against the device inside the dispatch "
         "window, the stall the lookahead pipeline (execution.py) exists "
         "to hide, and unmeasured ones corrupt the `host_blocked_us` "
@@ -755,6 +757,17 @@ class UnfencedBlockingReadRule(LintRule):
             and node.func.attr == "block_until_ready"
         ):
             return ".block_until_ready()"
+        # a bare concurrent-futures join: `<fut>.result()` with no timeout
+        # parks the host exactly like a device read (the prefetcher's
+        # shard waits, data/prefetch.py); timeout-bounded joins in tools
+        # and tests are outside the dispatch-window hazard
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "result"
+            and not node.args
+            and not node.keywords
+        ):
+            return ".result() future join"
         # host conversion wrapped DIRECTLY around a device-producing call
         if path in ("numpy.asarray", "numpy.array", "float", "int", "bool"):
             for arg in node.args[:1]:

@@ -97,6 +97,21 @@ TUNABLES = TunableSpace((
         site="ops/tree.py:_STREAM_CHUNK_ROWS",
     ),
     Tunable(
+        "shard_rows", 32768,
+        (8192, 16384, 32768, 65536, 131072),
+        doc="rows per on-disk shard of the out-of-core data plane; keep "
+            "equal to stream_chunk_rows for bit-identity with resident "
+            "stream fits",
+        site="data/shards.py:DEFAULT_SHARD_ROWS",
+    ),
+    Tunable(
+        "prefetch_depth", 2,
+        (1, 2, 3, 4),
+        doc="shards kept in flight past the one being consumed by the "
+            "streaming fit's prefetcher",
+        site="data/prefetch.py:DEFAULT_PREFETCH_DEPTH",
+    ),
+    Tunable(
         "predict_fused_max_cells", 2**27,
         (2**24, 2**25, 2**26, 2**27, 2**28, 2**29, 2**30),
         doc="rows*members*leaves budget of the fused predict routing "

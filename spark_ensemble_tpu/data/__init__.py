@@ -1,0 +1,30 @@
+"""Out-of-core data plane: bit-packed shard stores + streaming fits.
+
+``write_shards`` seals a binned, bit-packed dataset into a sha256-
+manifested shard directory; ``ShardStore`` is the verified read handle;
+``ShardPrefetcher`` streams shards one step ahead of the device; the
+``fit_streaming`` methods on ``GBMRegressor`` / ``GBMClassifier``
+(models/gbm.py) train over a store without ever materializing the
+packed matrix on device — bit-identically to a resident
+``hist="stream"`` fit.
+"""
+
+from spark_ensemble_tpu.data.prefetch import (
+    DEFAULT_PREFETCH_DEPTH,
+    ShardPrefetcher,
+)
+from spark_ensemble_tpu.data.shards import (
+    DEFAULT_SHARD_ROWS,
+    SHARD_FORMAT,
+    ShardStore,
+    write_shards,
+)
+
+__all__ = [
+    "DEFAULT_PREFETCH_DEPTH",
+    "DEFAULT_SHARD_ROWS",
+    "SHARD_FORMAT",
+    "ShardPrefetcher",
+    "ShardStore",
+    "write_shards",
+]

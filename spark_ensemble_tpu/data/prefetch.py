@@ -1,0 +1,132 @@
+"""Async double-buffered shard prefetch for the streaming fit.
+
+One worker thread reads shard files from disk while the device chews on
+the current shard's histogram program — the round loop's host I/O hides
+behind device compute instead of serializing with it.  The schedule is
+the consumer's by construction: every sweep walks shards ``0..S-1`` in
+order and sweeps repeat back-to-back (``max_depth + 1`` sweeps per
+round), so the prefetcher simply keeps the next ``prefetch_depth``
+indices of the cyclic order in flight.
+
+Threading contract: the WORKER thread only touches numpy + file IO; all
+JAX calls (``device_put``) and all telemetry run on the consumer thread
+inside ``sweep()``.  Consumer-side waits on a not-yet-finished shard are
+measured with a ``perf_counter`` fence and charged to the fit's
+``host_blocked_us`` accounting (telemetry/events.py) — the sanctioned
+fenced-wait shape the graftlint unfenced-blocking-read rule recognizes.
+
+Abandon-safety: a sweep generator may die mid-round (chaos preemption,
+a transient retry unwinding the dispatch).  In-flight futures are keyed
+by shard INDEX, not by queue position, so the next sweep reconciles
+against whatever is already loading — shard content is immutable, a
+loaded shard is valid whenever it arrives.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+from spark_ensemble_tpu.autotune.resolve import resolve as _tuned
+
+#: default lookahead (shards in flight past the one being consumed) —
+#: the "prefetch_depth" tunable's default (autotune/space.py)
+DEFAULT_PREFETCH_DEPTH = 2
+
+
+class ShardPrefetcher:
+    """Cyclic single-worker shard prefetcher over a ``ShardStore``."""
+
+    def __init__(self, store, depth: Optional[int] = None, telem=None,
+                 to_device: bool = True):
+        self.store = store
+        if depth is None:
+            depth = int(_tuned("prefetch_depth", DEFAULT_PREFETCH_DEPTH,
+                               n=store.n))
+        self.depth = max(1, int(depth))
+        self.telem = telem
+        self.to_device = to_device
+        self._ex = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="se-tpu-shard"
+        )
+        self._pending: Dict[int, Future] = {}
+        self._closed = False
+        self._stats = self._zero_stats()
+
+    @staticmethod
+    def _zero_stats():
+        return {
+            "loads": 0, "hits": 0, "misses": 0, "bytes": 0,
+            "load_s": 0.0, "wait_s": 0.0,
+        }
+
+    def _read(self, s: int) -> Tuple[np.ndarray, float]:
+        # worker thread: numpy + file IO only (no JAX, no telemetry)
+        t0 = time.perf_counter()
+        arr = self.store.load_shard(s)
+        return arr, time.perf_counter() - t0
+
+    def _schedule_from(self, pos: int) -> None:
+        S = self.store.num_shards
+        for j in range(self.depth + 1):
+            if len(self._pending) > self.depth:
+                break
+            s = (pos + j) % S
+            if s not in self._pending:
+                self._pending[s] = self._ex.submit(self._read, s)
+
+    def sweep(self) -> Iterator[Tuple[int, jax.Array]]:
+        """Yield ``(shard_index, packed_words)`` for shards ``0..S-1``."""
+        if self._closed:
+            raise RuntimeError("prefetcher is closed")
+        S = self.store.num_shards
+        for pos in range(S):
+            self._schedule_from(pos)
+            fut = self._pending.pop(pos, None)
+            if fut is None:  # pragma: no cover - reconcile safety net
+                fut = self._ex.submit(self._read, pos)
+            hit = fut.done()
+            t0 = time.perf_counter()
+            arr, load_s = fut.result()
+            wait_s = time.perf_counter() - t0
+            st = self._stats
+            st["loads"] += 1
+            st["bytes"] += arr.nbytes
+            st["load_s"] += load_s
+            st["hits" if hit else "misses"] += 1
+            st["wait_s"] += wait_s
+            if self.telem is not None and self.telem.enabled:
+                # the overlap miss the prefetcher exists to hide, charged
+                # to the same host-blocked ledger as device-read fences
+                self.telem.host_blocked(wait_s)
+            # keep the worker busy while the device consumes this shard
+            self._schedule_from(pos + 1)
+            if self.to_device:
+                arr = jax.device_put(arr)
+            yield pos, arr
+
+    def take_stats(self) -> Dict[str, float]:
+        """Counters accumulated since the last take (loads / hits /
+        misses / bytes / load_s / wait_s), then reset — the per-round
+        shard-I/O telemetry reads this after each round."""
+        out, self._stats = self._stats, self._zero_stats()
+        return out
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            fut.cancel()
+        self._pending.clear()
+        self._ex.shutdown(wait=True)
+
+    def __enter__(self) -> "ShardPrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

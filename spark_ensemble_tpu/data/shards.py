@@ -1,0 +1,252 @@
+"""On-disk bit-packed bin shards: the out-of-core training format.
+
+``write_shards`` bins a dataset ONCE (the same ``compute_bins`` /
+``bin_features`` pair every resident fit uses) and stores the bit-packed
+bin matrix (ops/binning.py ``pack_bins``) as row shards, each a
+``.npz`` holding the ``u32[rows, W]`` packed words.  The directory is
+sealed by a ``manifest.json`` carrying the format version, the dataset
+geometry and a sha256 per file — the same versioned, atomically renamed,
+hash-verified discipline as training checkpoints
+(utils/checkpoint.py), so a truncated write or a stale/corrupted shard
+is a hard error at ``ShardStore.open``, never silent wrong math.
+
+The default shard height equals the stream histogram tier's chunk rows
+(``stream_chunk_rows``, ops/tree.py ``_STREAM_CHUNK_ROWS``): a shard
+sweep in ``data/streaming.py`` then accumulates histograms across
+program calls in EXACTLY the per-chunk order of the resident
+``hist="stream"`` scan, which is what makes the streaming fit
+bit-identical to the resident fit on the same binned data
+(tests/test_streaming.py pins it).
+
+Only the bin matrix lives out of core — it is the round loop's dominant
+operand (``n*d`` cells re-read every tree level).  Labels, weights and
+carried predictions are ``O(n)`` vectors and stay resident.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_ensemble_tpu.autotune.resolve import resolve as _tuned
+from spark_ensemble_tpu.ops.binning import (
+    bin_features,
+    compute_bins,
+    pack_bins,
+)
+from spark_ensemble_tpu.utils.checkpoint import _file_sha256
+
+#: on-disk format version; bumped on any layout change so an old store
+#: is rejected instead of misread (mirrors _CHECKPOINT_FORMAT)
+SHARD_FORMAT = 1
+
+#: default rows per shard — MUST mirror ops/tree.py _STREAM_CHUNK_ROWS
+#: (the "shard_rows" tunable's default; bit-identity with the resident
+#: stream tier needs shard height == stream chunk height)
+DEFAULT_SHARD_ROWS = 32768
+
+_MANIFEST = "manifest.json"
+_THRESHOLDS = "thresholds.npz"
+
+
+def _sha_entry(path: str) -> Dict[str, Any]:
+    return {"sha256": _file_sha256(path), "bytes": os.path.getsize(path)}
+
+
+def write_shards(
+    X,
+    directory: str,
+    *,
+    max_bins: int = 64,
+    shard_rows: Optional[int] = None,
+    bits: int = 0,
+    overwrite: bool = False,
+) -> "ShardStore":
+    """Bin + pack ``X`` into a sealed shard directory -> opened store.
+
+    One pass: quantile thresholds over the full matrix (identical to the
+    resident fit's ``compute_bins``), then per-shard ``bin_features`` +
+    ``pack_bins`` (row-wise, so per-shard packing equals slicing a
+    whole-matrix packing).  Written to a temp dir and atomically renamed
+    into place; a crash mid-write leaves no half-readable store.
+    """
+    X = np.asarray(X, np.float32)
+    if X.ndim != 2:
+        raise ValueError(f"X must be 2-d, got shape {X.shape}")
+    n, d = X.shape
+    if shard_rows is None:
+        shard_rows = min(int(_tuned("shard_rows", DEFAULT_SHARD_ROWS, n=n)), n)
+    shard_rows = max(1, int(shard_rows))
+    num_shards = -(-n // shard_rows)
+
+    directory = os.path.abspath(directory)
+    if os.path.exists(os.path.join(directory, _MANIFEST)) and not overwrite:
+        raise FileExistsError(
+            f"shard store already exists at {directory} "
+            "(pass overwrite=True to replace it)"
+        )
+    parent = os.path.dirname(directory) or "."
+    os.makedirs(parent, exist_ok=True)
+
+    bins = compute_bins(jnp.asarray(X), max_bins)
+    thresholds = np.asarray(bins.thresholds, np.float32)
+
+    tmp = tempfile.mkdtemp(dir=parent, prefix=".shards-tmp-")
+    try:
+        shards: List[Dict[str, Any]] = []
+        bits_resolved = None
+        words_per_row = None
+        for s in range(num_shards):
+            lo = s * shard_rows
+            hi = min(n, lo + shard_rows)
+            Xb = bin_features(jnp.asarray(X[lo:hi]), bins)
+            cb = pack_bins(Xb, max_bins, bits=bits)
+            if bits_resolved is None:
+                bits_resolved = int(cb.bits)
+                words_per_row = int(cb.packed.shape[1])
+            fname = f"shard-{s:05d}.npz"
+            fpath = os.path.join(tmp, fname)
+            np.savez(fpath, packed=np.asarray(cb.packed, np.uint32))
+            shards.append(
+                {"index": s, "file": fname, "rows": hi - lo, **_sha_entry(fpath)}
+            )
+        tpath = os.path.join(tmp, _THRESHOLDS)
+        np.savez(tpath, thresholds=thresholds)
+        manifest = {
+            "format": SHARD_FORMAT,
+            "n": n,
+            "d": d,
+            "max_bins": int(max_bins),
+            "bits": bits_resolved,
+            "words_per_row": words_per_row,
+            "shard_rows": int(shard_rows),
+            "thresholds": {"file": _THRESHOLDS, **_sha_entry(tpath)},
+            "shards": shards,
+        }
+        with open(os.path.join(tmp, _MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=1)
+        if os.path.exists(directory):
+            # overwrite: swap the old store out of the way first so the
+            # final rename stays a single atomic transition
+            old = tempfile.mkdtemp(dir=parent, prefix=".shards-old-")
+            os.rename(directory, os.path.join(old, "store"))
+            shutil.rmtree(old, ignore_errors=True)
+        os.rename(tmp, directory)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return ShardStore.open(directory)
+
+
+class ShardStore:
+    """Read handle on a sealed shard directory (see ``write_shards``).
+
+    ``open`` verifies the manifest's format version and every listed
+    file's size + sha256 before any math runs — a shard store is trusted
+    the way a checkpoint is trusted, by hash, not by mtime.
+    """
+
+    def __init__(self, directory: str, manifest: Dict[str, Any],
+                 thresholds: np.ndarray):
+        self.directory = directory
+        self._manifest = manifest
+        self._thresholds = thresholds
+
+    # -- geometry ------------------------------------------------------
+    @property
+    def n(self) -> int:
+        return int(self._manifest["n"])
+
+    @property
+    def d(self) -> int:
+        return int(self._manifest["d"])
+
+    @property
+    def max_bins(self) -> int:
+        return int(self._manifest["max_bins"])
+
+    @property
+    def bits(self) -> int:
+        return int(self._manifest["bits"])
+
+    @property
+    def words_per_row(self) -> int:
+        return int(self._manifest["words_per_row"])
+
+    @property
+    def shard_rows(self) -> int:
+        return int(self._manifest["shard_rows"])
+
+    @property
+    def num_shards(self) -> int:
+        return len(self._manifest["shards"])
+
+    @property
+    def thresholds(self) -> np.ndarray:
+        """f32[d, max_bins-1] split thresholds — identical to the
+        resident fit ctx's (same ``compute_bins`` over the same X)."""
+        return self._thresholds
+
+    @property
+    def packed_nbytes(self) -> int:
+        """Total bytes of packed bin words across all shards — the
+        operand the out-of-core budget is measured against."""
+        return sum(int(s["bytes"]) for s in self._manifest["shards"])
+
+    def shard_meta(self, i: int) -> Dict[str, Any]:
+        return self._manifest["shards"][i]
+
+    # -- IO ------------------------------------------------------------
+    @classmethod
+    def open(cls, directory: str, verify: bool = True) -> "ShardStore":
+        directory = os.path.abspath(directory)
+        mpath = os.path.join(directory, _MANIFEST)
+        if not os.path.exists(mpath):
+            raise FileNotFoundError(f"no shard manifest at {mpath}")
+        with open(mpath) as f:
+            manifest = json.load(f)
+        fmt = manifest.get("format")
+        if fmt != SHARD_FORMAT:
+            raise ValueError(
+                f"shard store format {fmt} unsupported "
+                f"(expected {SHARD_FORMAT}); re-run write_shards"
+            )
+        entries = list(manifest["shards"]) + [manifest["thresholds"]]
+        for ent in entries:
+            fpath = os.path.join(directory, ent["file"])
+            if not os.path.exists(fpath):
+                raise FileNotFoundError(f"shard store missing {fpath}")
+            size = os.path.getsize(fpath)
+            if size != int(ent["bytes"]):
+                raise ValueError(
+                    f"shard store file {ent['file']} is {size} bytes, "
+                    f"manifest says {ent['bytes']} — truncated or stale"
+                )
+            if verify and _file_sha256(fpath) != ent["sha256"]:
+                raise ValueError(
+                    f"shard store file {ent['file']} failed its sha256 "
+                    "check — corrupted or tampered"
+                )
+        with np.load(os.path.join(directory, manifest["thresholds"]["file"])) as z:
+            thresholds = np.asarray(z["thresholds"], np.float32)
+        return cls(directory, manifest, thresholds)
+
+    def load_shard(self, i: int) -> np.ndarray:
+        """Shard ``i``'s packed words, zero-padded to ``shard_rows``
+        (u32[shard_rows, W]).  Zero words unpack to bin-0 rows, and every
+        consumer pairs them with all-zero value channels, so the padding
+        contributes exactly 0.0 to every statistic — same rule as the
+        resident stream tier's row padding."""
+        ent = self._manifest["shards"][i]
+        with np.load(os.path.join(self.directory, ent["file"])) as z:
+            packed = np.asarray(z["packed"], np.uint32)
+        rows = packed.shape[0]
+        if rows < self.shard_rows:
+            packed = np.pad(packed, ((0, self.shard_rows - rows), (0, 0)))
+        return packed

@@ -1,0 +1,967 @@
+"""Out-of-core GBM training over bit-packed shard stores.
+
+The resident ``hist="stream"`` tier (ops/tree.py ``_fit_forest_streamed``)
+already computes each tree level as a ``lax.scan`` over row chunks of the
+binned feature matrix — but the matrix itself lives on device.  This
+module replaces that scan with a SHARD SWEEP: the packed bin matrix
+stays on disk (data/shards.py), an async prefetcher (data/prefetch.py)
+streams one shard ahead of the device, and each sweep step runs one
+cached per-level program whose body is literally the same
+``stream_level_step`` the resident scan folds — same contractions, same
+precisions, same sequential accumulation order.  That is the whole
+bit-identity argument: a streaming fit and a resident ``hist="stream"``
+fit with ``stream_chunk_rows == shard_rows`` execute the same f32 ops on
+the same operands in the same order (XLA does not reassociate f32 across
+kernel boundaries), so the fitted params are EQUAL, not close
+(tests/test_streaming.py pins it per family).
+
+Program inventory per fit is fixed and small (~``2*max_depth + 5``
+cached programs), independent of shard count and round count: per-shard
+state (``node_all [S, R, M]`` ids, ``vals_all [S, R, M, C]`` value
+channels) stays resident and programs address the current shard with a
+TRACED index (``lax.dynamic_index_in_dim``) — no per-shard or per-round
+retraces, which the graftlint program-contract checker budgets
+(analysis/contracts.json).
+
+Only the packed bin matrix is out of core.  Labels, weights, carried
+predictions, per-shard node ids and value channels are ``O(n)`` vectors
+and stay resident — the budget targets the dominant ``n*d``-scale
+operand the round loop re-reads every level.
+
+The round loop itself routes through the SAME ``_drive_rounds`` /
+``RoundExecutor`` machinery as the resident fits (execution.py): chunked
+dispatch, patience early-stop, checkpoint cadence, numeric-guard
+recovery and chaos semantics are shared, and checkpoints are
+INTERCHANGEABLE with resident ones (same fingerprint shape parts, and
+the states are bit-identical anyway) — a fit killed mid-shard resumes
+from the last round boundary like any other fit.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_ensemble_tpu.models.base import (
+    as_f32,
+    cached_program,
+    infer_num_classes,
+    resolve_weights,
+)
+from spark_ensemble_tpu.models.dummy import DummyClassifier
+from spark_ensemble_tpu.models.tree import DecisionTreeRegressor
+from spark_ensemble_tpu.ops import losses as losses_mod
+from spark_ensemble_tpu.ops.binning import CompressedBins, unpack_bins
+from spark_ensemble_tpu.ops.linesearch import (
+    brent_minimize,
+    projected_newton_box,
+)
+from spark_ensemble_tpu.ops.tree import (
+    _HIST_PRECISION,
+    _routing_precision,
+    Tree,
+    predict_chunked_rows,
+    stream_leaf_step,
+    stream_leaf_values,
+    stream_level_step,
+    stream_level_update,
+    stream_vals_prep,
+)
+from spark_ensemble_tpu.telemetry.events import FitTelemetry
+from spark_ensemble_tpu.utils.instrumentation import Instrumentation
+from spark_ensemble_tpu.utils.quantile import weighted_quantile
+
+from spark_ensemble_tpu.data.prefetch import ShardPrefetcher
+
+logger = logging.getLogger(__name__)
+
+_PRECISION_LH = (jax.lax.Precision.DEFAULT, jax.lax.Precision.HIGHEST)
+
+
+# ---------------------------------------------------------------------------
+# per-level shard programs (family-agnostic: M, C ride in on shapes)
+# ---------------------------------------------------------------------------
+
+
+def _shard_level_prog(level: int, B: int, bits: int, d: int, prec: str):
+    """One shard's contribution to level ``level``'s histograms:
+    unpack in-program, route through the previous level's tables
+    (``level > 0``), matmul-accumulate — the resident scan body
+    (``stream_level_step``) addressed by a traced shard index."""
+    stat_prec = _HIST_PRECISION[prec]
+    route_prec = _routing_precision(B)
+    n_nodes = 2 ** level
+
+    def build():
+        def step(acc, packed, node_all, vals_all, s, tables):
+            xb = unpack_bins(
+                CompressedBins(packed=packed, bits=bits, num_features=d)
+            )
+            nd = jax.lax.dynamic_index_in_dim(
+                node_all, s, axis=0, keepdims=False
+            )
+            vl = jax.lax.dynamic_index_in_dim(
+                vals_all, s, axis=0, keepdims=False
+            )
+            acc, nd = stream_level_step(
+                acc, xb, nd, vl, n_nodes=n_nodes, tables=tables,
+                max_bins=B, stat_prec=stat_prec, route_prec=route_prec,
+            )
+            node_all = jax.lax.dynamic_update_index_in_dim(
+                node_all, nd, s, axis=0
+            )
+            return acc, node_all
+
+        if level == 0:
+            run = lambda acc, packed, node_all, vals_all, s: step(
+                acc, packed, node_all, vals_all, s, None
+            )
+        else:
+            run = lambda acc, packed, node_all, vals_all, s, bf, bt: step(
+                acc, packed, node_all, vals_all, s, (bf, bt)
+            )
+        return jax.jit(run)
+
+    return cached_program(
+        ("stream_shard_level", level, B, bits, d, prec), build
+    )
+
+
+def _level_finish_prog(level: int, B: int, d: int, prec: str,
+                       min_gain: float):
+    """Score one level's swept histograms and write its heap rows —
+    the resident path's ``stream_level_update`` behind a cached call."""
+    stat_prec = _HIST_PRECISION[prec]
+
+    def build():
+        def run(H, mask, thresholds, parent_value, sf, sb, st, sg):
+            fm = jnp.broadcast_to(mask[None, :], (H.shape[0], d))
+            tables, parent_value, sf, sb, st, sg = stream_level_update(
+                H, fm, min_gain, thresholds, B, stat_prec, level,
+                parent_value, sf, sb, st, sg,
+            )
+            return tables[0], tables[1], parent_value, sf, sb, st, sg
+
+        return jax.jit(run)
+
+    return cached_program(
+        ("stream_level_finish", level, B, d, prec, min_gain), build
+    )
+
+
+def _shard_leaf_prog(max_depth: int, B: int, bits: int, d: int, prec: str):
+    """One shard's contribution to the leaf sums (``stream_leaf_step``),
+    updating the resident per-shard node ids in place."""
+    stat_prec = _HIST_PRECISION[prec]
+    route_prec = _routing_precision(B)
+    num_leaves = 2 ** max_depth
+
+    def build():
+        def run(acc, packed, node_all, vals_all, s, bf, bt):
+            xb = unpack_bins(
+                CompressedBins(packed=packed, bits=bits, num_features=d)
+            )
+            nd = jax.lax.dynamic_index_in_dim(
+                node_all, s, axis=0, keepdims=False
+            )
+            vl = jax.lax.dynamic_index_in_dim(
+                vals_all, s, axis=0, keepdims=False
+            )
+            acc, nd = stream_leaf_step(
+                acc, xb, nd, vl, num_leaves=num_leaves, tables=(bf, bt),
+                stat_prec=stat_prec, route_prec=route_prec,
+            )
+            node_all = jax.lax.dynamic_update_index_in_dim(
+                node_all, nd, s, axis=0
+            )
+            return acc, node_all
+
+        return jax.jit(run)
+
+    return cached_program(
+        ("stream_shard_leaf", max_depth, B, bits, d, prec), build
+    )
+
+
+def _leaf_finish_prog():
+    def build():
+        def run(L, parent_value, y_mean):
+            return stream_leaf_values(
+                L[:, :, 0], L[:, :, 1:], parent_value, y_mean
+            )
+
+        return jax.jit(run)
+
+    return cached_program(("stream_leaf_finish",), build)
+
+
+def _sweep_forest(prefetch, ctl, site, vals_p, y_mean, mask, thresholds, *,
+                  max_depth, B, bits, d, prec, min_gain):
+    """Fit M trees over the shard store: ``max_depth + 1`` shard sweeps
+    (one histogram sweep per level, one leaf sweep) -> ``(Tree [M, ...],
+    node_all [S, R, M])``.  Mirrors ``_fit_forest_streamed`` exactly,
+    with the ``lax.scan`` replaced by the prefetched shard loop."""
+    S, R, M, C = vals_p.shape
+    num_internal = 2 ** max_depth - 1
+    sf = jnp.zeros((M, num_internal), jnp.int32)
+    sb = jnp.zeros((M, num_internal), jnp.int32)
+    stt = jnp.zeros((M, num_internal), jnp.float32)
+    sg = jnp.zeros((M, num_internal), jnp.float32)
+    parent_value = y_mean[:, None, :]
+    node_all = jnp.zeros((S, R, M), jnp.int32)
+    best_f = best_t = None
+    for level in range(max_depth):
+        prog = _shard_level_prog(level, B, bits, d, prec)
+        acc = jnp.zeros((M, 2 ** level, C, d, B), jnp.float32)
+        for s, packed in prefetch.sweep():
+            # chaos: a mid-shard kill lands between two accumulation
+            # programs — resume must replay the round from its last
+            # checkpoint without double-counting any shard
+            ctl.preempt(f"{site}:level:{level}:shard:{s}")
+            if level == 0:
+                acc, node_all = prog(
+                    acc, packed, node_all, vals_p, np.int32(s)
+                )
+            else:
+                acc, node_all = prog(
+                    acc, packed, node_all, vals_p, np.int32(s),
+                    best_f, best_t,
+                )
+        fin = _level_finish_prog(level, B, d, prec, min_gain)
+        best_f, best_t, parent_value, sf, sb, stt, sg = fin(
+            acc, mask, thresholds, parent_value, sf, sb, stt, sg
+        )
+    leaf = _shard_leaf_prog(max_depth, B, bits, d, prec)
+    acc = jnp.zeros((M, 2 ** max_depth, C), jnp.float32)
+    for s, packed in prefetch.sweep():
+        ctl.preempt(f"{site}:leaf:shard:{s}")
+        acc, node_all = leaf(
+            acc, packed, node_all, vals_p, np.int32(s), best_f, best_t
+        )
+    leaf_value = _leaf_finish_prog()(acc, parent_value, y_mean)
+    tree = Tree(
+        split_feature=sf, split_bin=sb, split_threshold=stt,
+        leaf_value=leaf_value, split_gain=sg,
+    )
+    return tree, node_all
+
+
+def _dir_reg_prog(n: int):
+    """Per-row direction from the swept leaf ids — the single-tree
+    leaf-id contraction of ``models/tree.py:_fit_and_leaf_pred``."""
+
+    def build():
+        def run(node_all, leaf_value):  # [S, R, 1], [1, L, k]
+            node = node_all.reshape(-1, 1)[:n]
+            lv = leaf_value[0]
+            L = lv.shape[0]
+
+            def rows(nd):
+                oh = jax.nn.one_hot(nd[:, 0], L, dtype=jnp.float32)
+                return jax.lax.dot_general(
+                    oh, lv, (((1,), (0,)), ((), ())),
+                    precision=_PRECISION_LH,
+                )
+
+            return predict_chunked_rows(rows, node, 1, L)[..., 0]
+
+        return jax.jit(run)
+
+    return cached_program(("stream_dir_reg", n), build)
+
+
+def _dir_cls_prog(n: int):
+    """Per-row, per-class-dim directions — the fused-member leaf-id
+    contraction of ``models/tree.py:fit_many_and_directions``."""
+
+    def build():
+        def run(node_all, leaf_value):  # [S, R, M], [M, L, k]
+            M, L = leaf_value.shape[:2]
+            node = node_all.reshape(-1, M)[:n]
+
+            def rows(nd):
+                oh = jax.nn.one_hot(nd, L, dtype=jnp.float32)
+                return jnp.einsum(
+                    "nml,mlk->nmk", oh, leaf_value,
+                    precision=_PRECISION_LH,
+                )
+
+            return predict_chunked_rows(rows, node, M, L)[..., 0]
+
+        return jax.jit(run)
+
+    return cached_program(("stream_dir_cls", n), build)
+
+
+# ---------------------------------------------------------------------------
+# shared setup
+# ---------------------------------------------------------------------------
+
+
+def _check_store(est, store, y):
+    base = est._base().copy()
+    if not isinstance(base, DecisionTreeRegressor):
+        raise ValueError(
+            "fit_streaming supports histogram DecisionTreeRegressor base "
+            f"learners; got {type(base).__name__}"
+        )
+    if int(base.max_bins) != store.max_bins:
+        raise ValueError(
+            f"base learner max_bins={base.max_bins} does not match the "
+            f"shard store's max_bins={store.max_bins}; the store's "
+            "thresholds were computed at write_shards time"
+        )
+    if y.shape[0] != store.n:
+        raise ValueError(
+            f"y has {y.shape[0]} rows, shard store has {store.n}"
+        )
+    return base
+
+
+def _emit_shard_io(telem, prefetch):
+    """Per-round shard-I/O events through the fit's telemetry stream
+    (tools/telemetry_report.py folds them into the shard-I/O share)."""
+    if telem is None or not telem.enabled:
+        prefetch.take_stats()
+        return
+    st = prefetch.take_stats()
+    if not st["loads"]:
+        return
+    telem.emit(
+        "shard_load", count=st["loads"], bytes=st["bytes"],
+        duration_us=int(st["load_s"] * 1e6),
+    )
+    telem.emit(
+        "shard_prefetch_hit", hits=st["hits"], misses=st["misses"],
+    )
+    telem.emit("shard_wait_us", wait_us=int(st["wait_s"] * 1e6))
+
+
+# ---------------------------------------------------------------------------
+# regressor
+# ---------------------------------------------------------------------------
+
+
+def fit_streaming_regressor(est, store, y, sample_weight=None, X_val=None,
+                            y_val=None):
+    """Out-of-core ``GBMRegressor`` fit over a ``ShardStore`` — the
+    streaming twin of ``GBMRegressor.fit`` (models/gbm.py), bit-identical
+    to a resident ``hist="stream"`` fit with matched chunk rows.  The
+    validation split (if any) stays resident (raw features)."""
+    from spark_ensemble_tpu.models.gbm import (
+        GBMRegressionModel,
+        _pseudo_residuals_and_weights,
+        _round_cost,
+        concat_pytrees,
+        slice_pytree,
+    )
+    from spark_ensemble_tpu.robustness.chaos import controller
+
+    y = as_f32(y)
+    base = _check_store(est, store, y)
+    if est.init_strategy.lower() == "base":
+        raise ValueError(
+            "init_strategy='base' needs resident features; use "
+            "'constant' or 'zero' for streaming fits"
+        )
+    w = resolve_weights(y, sample_weight)
+    n, d = store.n, store.d
+    S, R = store.num_shards, store.shard_rows
+    B, bits = store.max_bins, store.bits
+    max_depth = int(base.max_depth)
+    prec = str(base.hist_precision).lower()
+    min_gain = float(base.min_info_gain)
+
+    instr = Instrumentation("GBMRegressor.fit_streaming")
+    instr.log_params(est.get_params())
+    instr.log_dataset(n, d)
+    telem = FitTelemetry.start(est, n=n, d=d)
+    telem.emit(
+        "streaming_config", shards=S, shard_rows=R, bits=bits,
+        packed_bytes=store.packed_nbytes,
+    )
+    bag_keys, masks = est._sampling_plan(n, d)
+    bag_many = est._make_bag_many_fn(n, n)
+    ctl = controller()
+
+    # placeholder features: every supported init strategy is a Dummy fit
+    # that reads only (y, w) and predicts a broadcast constant
+    X_ph = jnp.zeros((n, 1), jnp.float32)
+    init_model = est._fit_init(X_ph, y, w)
+    huber = est.loss.lower() == "huber"
+    if huber:
+        full_y = (
+            jnp.concatenate([y, as_f32(y_val)]) if y_val is not None else y
+        )
+        delta = weighted_quantile(full_y, est.alpha)
+    else:
+        delta = jnp.asarray(0.0, jnp.float32)
+    pred = init_model.predict(X_ph)
+    valid_w = jnp.ones((n,), jnp.float32)
+    y = jnp.asarray(y)
+    w = jnp.asarray(w)
+    thresholds = jnp.asarray(store.thresholds)
+
+    updates = est.updates.lower()
+    optimized = bool(est.optimized_weights)
+    lr = float(est.learning_rate)
+    goss = (
+        (float(est.top_rate), float(est.other_rate))
+        if est.sample_method.lower() == "goss"
+        else None
+    )
+    tol = float(est.tol)
+    max_iter = int(est.max_iter)
+    alpha_q = float(est.alpha)
+    loss_name = est.loss.lower()
+    base_key = base.config_key()
+    with_validation = X_val is not None
+
+    def make_loss(delta):
+        if loss_name == "huber":
+            return losses_mod.HuberLoss(delta)
+        return losses_mod.get_regression_loss(
+            loss_name, alpha=alpha_q, quantile=alpha_q
+        )
+
+    stream_key = (
+        "gbm_reg_stream", loss_name, alpha_q, updates, optimized, lr,
+        goss, float(est.subsample_ratio), bool(est.replacement), tol,
+        max_iter, base_key,
+    )
+
+    def build_prep():
+        def run(y, w, valid_w, pred, delta, bag_w, key):
+            if huber:
+                delta = weighted_quantile(
+                    jnp.abs(y - pred), alpha_q, weights=valid_w
+                )
+            loss = make_loss(delta)
+            y_enc = loss.encode_label(y)
+            labels, fit_w, bag_w = _pseudo_residuals_and_weights(
+                loss, updates, y_enc, pred[:, None], bag_w, w,
+                goss=goss, goss_key=jax.random.fold_in(key, 7),
+            )
+            Y = labels[:, 0][:, None, None]  # [n, 1, 1]
+            wf = fit_w[:, 0][:, None]  # [n, 1]
+            _, y_mean, vals = stream_vals_prep(Y, wf)
+            vals_p = jnp.pad(
+                vals, ((0, S * R - n), (0, 0), (0, 0))
+            ).reshape(S, R, 1, 2)
+            return vals_p, y_mean, bag_w, delta
+
+        return jax.jit(run)
+
+    def build_update():
+        def run(y, pred, direction, bag_w, delta, scale):
+            loss = make_loss(delta)
+            y_enc = loss.encode_label(y)
+            if optimized and loss_name == "squared":
+                res = y - pred
+                num = jnp.sum(bag_w * direction * res)
+                den = jnp.sum(bag_w * direction * direction)
+                alpha_opt = jnp.where(
+                    den > 1e-30,
+                    jnp.clip(num / jnp.maximum(den, 1e-30), 0.0, 100.0),
+                    jnp.asarray(1.0, jnp.float32),
+                )
+            elif optimized:
+                def phi(a):
+                    return jnp.sum(
+                        bag_w
+                        * loss.loss(y_enc, (pred + a * direction)[:, None])
+                    )
+
+                alpha_opt = brent_minimize(
+                    phi, 0.0, 100.0, tol=tol, max_iter=max_iter
+                )
+            else:
+                alpha_opt = jnp.asarray(1.0, jnp.float32)
+            weight = jnp.where(scale > 0, lr * alpha_opt * scale, 0.0)
+            new_pred = pred + jnp.where(scale > 0, weight * direction, 0.0)
+            return weight, new_pred
+
+        return jax.jit(run)
+
+    def build_val():
+        def run(params, X_val, pred_val, weight, delta, y_val, scale):
+            dir_val = base.predict_fn(params, X_val)
+            new_pred_val = pred_val + jnp.where(
+                scale > 0, weight * dir_val, 0.0
+            )
+            l = make_loss(delta)
+            err = jnp.mean(
+                l.loss(l.encode_label(y_val), new_pred_val[:, None])
+            )
+            return err, new_pred_val
+
+        return jax.jit(run)
+
+    prep = cached_program(stream_key + ("prep", huber, n, R), build_prep)
+    upd = cached_program(stream_key + ("update",), build_update)
+    valp = cached_program(stream_key + ("val",), build_val)
+    dirp = _dir_reg_prog(n)
+    eval_loss = cached_program(
+        ("gbm_reg_eval", loss_name, alpha_q),
+        lambda: jax.jit(
+            lambda pred_v, delta, y_v: jnp.mean(
+                make_loss(delta).loss(
+                    make_loss(delta).encode_label(y_v), pred_v[:, None]
+                )
+            )
+        ),
+    )
+
+    best = 0.0
+    pred_val = None
+    nv_pad = 0
+    if with_validation:
+        X_val = as_f32(X_val)
+        y_val = as_f32(y_val)
+        pred_val = init_model.predict(X_val)
+        best = float(eval_loss(pred_val, delta, y_val))
+        nv_pad = X_val.shape[0]
+
+    members_chunks: List[Any] = []
+    weights_chunks: List[Any] = []
+    val_history: List[float] = []
+    i, v = 0, 0
+
+    # same fingerprint shape parts as the resident fit (n_pad == n): the
+    # two paths produce bit-identical state, so their checkpoints are
+    # interchangeable by construction
+    ckpt = est._checkpointer(n, d, n, nv_pad, telem=telem)
+    resumed = ckpt.load_latest()
+    if resumed is not None:
+        last_round, st = resumed
+        detail = ckpt.last_load_detail or {}
+        telem.emit(
+            "resume_from_checkpoint",
+            round=last_round + 1,
+            source=detail.get("source", "latest"),
+            fallback=bool(detail.get("fallback", False)),
+        )
+        i, v, best = last_round + 1, int(st["v"]), float(st["best"])
+        val_history[:] = [
+            float(x) for x in np.asarray(st.get("val_hist", []))
+        ]
+        pred = jnp.asarray(st["pred"])
+        pred_val = st.get("pred_val")
+        if pred_val is not None:
+            pred_val = jnp.asarray(pred_val)
+        members_chunks, weights_chunks = est._resume_chunks(st)
+        delta = jnp.asarray(st["delta"])
+        logger.info("GBMRegressor streaming resume from round %d", i)
+
+    def save_state(round_idx, v, best):
+        if not ckpt.should_save(round_idx):
+            return
+        ckpt.save(
+            round_idx,
+            {
+                "v": v,
+                "best": best,
+                "val_hist": jnp.asarray(val_history, jnp.float32),
+                "pred": pred,
+                "pred_val": pred_val,
+                "members_layout": est.MEMBERS_LAYOUT,
+                "members": concat_pytrees(members_chunks),
+                "weights": concat_pytrees(weights_chunks),
+                "delta": delta,
+            },
+        )
+
+    prefetch = ShardPrefetcher(store, telem=telem)
+    try:
+        def run_chunk(sl, step_scale=1.0):
+            nonlocal pred, pred_val, delta
+            c = sl.stop - sl.start
+            bag_c = bag_many(bag_keys[sl])
+            keys_c, masks_c = bag_keys[sl], masks[sl]
+            params_l, weights_l, errs_l = [], [], []
+            for j in range(c):
+                r = sl.start + j
+                scale = np.float32(step_scale)
+                vals_p, y_mean, bag_w, delta = prep(
+                    y, w, valid_w, pred, delta, bag_c[j], keys_c[j]
+                )
+                forest, node_all = _sweep_forest(
+                    prefetch, ctl, f"GBMRegressor:stream_round:{r}",
+                    vals_p, y_mean, masks_c[j], thresholds,
+                    max_depth=max_depth, B=B, bits=bits, d=d, prec=prec,
+                    min_gain=min_gain,
+                )
+                direction = dirp(node_all, forest.leaf_value)
+                # unbatch M=1 — the member layout the resident fit stores
+                tree = jax.tree_util.tree_map(lambda a: a[0], forest)
+                weight, pred = upd(y, pred, direction, bag_w, delta, scale)
+                if with_validation:
+                    err, pred_val = valp(
+                        tree, X_val, pred_val, weight, delta, y_val, scale
+                    )
+                    errs_l.append(err)
+                params_l.append(tree)
+                weights_l.append(weight)
+                _emit_shard_io(telem, prefetch)
+            params_c = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *params_l
+            )
+            weights_c = jnp.stack(weights_l)
+            errs = jnp.stack(errs_l) if with_validation else None
+            return params_c, weights_c, errs
+
+        def snapshot():
+            return pred, pred_val, delta
+
+        def restore(snap):
+            nonlocal pred, pred_val, delta
+            pred, pred_val, delta = snap
+
+        telem.phase_mark("setup")
+        i, v, best = est._drive_rounds(
+            ckpt, members_chunks, weights_chunks,
+            run_chunk, save_state, "GBMRegressor", i, v, best,
+            val_history=val_history, telem=telem,
+            guard=est._numeric_guard(telem),
+            snapshot=snapshot, restore=restore, n_rows=n,
+            round_cost=_round_cost(base, n, d, 1),
+        )
+    finally:
+        prefetch.close()
+    ckpt.delete()
+
+    keep = i - v
+    instr.log_outcome(rounds=i, kept_members=keep)
+    all_members = concat_pytrees(members_chunks) if members_chunks else None
+    all_weights = (
+        jnp.concatenate(weights_chunks) if weights_chunks else None
+    )
+    model = GBMRegressionModel(
+        params={
+            "members": slice_pytree(all_members, keep) if keep > 0 else None,
+            "weights": all_weights[:keep] if keep > 0 else jnp.zeros((0,)),
+            "masks": masks[:keep],
+            "init": init_model.params,
+            "val_hist": jnp.asarray(val_history, jnp.float32)
+            if with_validation
+            else None,
+        },
+        num_features=d,
+        init_model=init_model,
+        num_members=keep,
+        **est.get_params(),
+    )
+    telem.finish(model=model, rounds=i, kept_members=keep)
+    return model
+
+
+# ---------------------------------------------------------------------------
+# classifier
+# ---------------------------------------------------------------------------
+
+
+def fit_streaming_classifier(est, store, y, sample_weight=None, X_val=None,
+                             y_val=None, num_classes=None):
+    """Out-of-core ``GBMClassifier`` fit over a ``ShardStore`` — the
+    streaming twin of ``GBMClassifier.fit`` (single-chip path; the class
+    dims fold into the shard programs' M axis like the resident fused
+    forest)."""
+    from spark_ensemble_tpu.models.gbm import (
+        GBMClassificationModel,
+        _pseudo_residuals_and_weights,
+        _round_cost,
+        concat_pytrees,
+        slice_pytree,
+    )
+    from spark_ensemble_tpu.robustness.chaos import controller
+
+    y = as_f32(y)
+    base = _check_store(est, store, y)
+    w = resolve_weights(y, sample_weight)
+    n, d = store.n, store.d
+    S, R = store.num_shards, store.shard_rows
+    B, bits = store.max_bins, store.bits
+    max_depth = int(base.max_depth)
+    prec = str(base.hist_precision).lower()
+    min_gain = float(base.min_info_gain)
+    num_classes = infer_num_classes(y, num_classes)
+
+    instr = Instrumentation("GBMClassifier.fit_streaming")
+    instr.log_params(est.get_params())
+    instr.log_dataset(n, d, num_classes)
+    telem = FitTelemetry.start(
+        est, n=n, d=d, num_classes=int(num_classes)
+    )
+    telem.emit(
+        "streaming_config", shards=S, shard_rows=R, bits=bits,
+        packed_bytes=store.packed_nbytes,
+    )
+    bag_keys, masks = est._sampling_plan(n, d)
+    bag_many = est._make_bag_many_fn(n, n)
+    ctl = controller()
+    loss = est._make_loss(num_classes)
+    dim = loss.dim
+    y_enc = loss.encode_label(y)
+
+    X_ph = jnp.zeros((n, 1), jnp.float32)
+    init_dummy = DummyClassifier(strategy=est.init_strategy)
+    init_model = init_dummy.fit(
+        X_ph, y, sample_weight=w, num_classes=num_classes
+    )
+    if dim == 1 and num_classes == 2 and est.init_strategy.lower() == "prior":
+        p1 = init_model.params["proba"][1]
+        logodds = jnp.log(
+            jnp.maximum(p1, 1e-30) / jnp.maximum(1.0 - p1, 1e-30)
+        )
+        init_raw = logodds[None]
+    elif dim == 1:
+        init_raw = jnp.zeros((1,), jnp.float32)
+    else:
+        init_raw = init_model.params["raw"]
+    pred = jnp.broadcast_to(init_raw[None, :], (n, dim)).astype(jnp.float32)
+    w = jnp.asarray(w)
+    thresholds = jnp.asarray(store.thresholds)
+
+    updates = est.updates.lower()
+    optimized = bool(est.optimized_weights)
+    lr = float(est.learning_rate)
+    goss = (
+        (float(est.top_rate), float(est.other_rate))
+        if est.sample_method.lower() == "goss"
+        else None
+    )
+    tol = float(est.tol)
+    max_iter = int(est.max_iter)
+    loss_name = est.loss.lower()
+    base_key = base.config_key()
+    with_validation = X_val is not None
+    if with_validation:
+        X_val = as_f32(X_val)
+        y_enc_val = loss.encode_label(as_f32(y_val))
+
+    stream_key = (
+        "gbm_cls_stream", loss_name, num_classes, updates, optimized, lr,
+        goss, float(est.subsample_ratio), bool(est.replacement), tol,
+        max_iter, base_key,
+    )
+
+    def build_prep():
+        def run(y_enc, w, pred, bag_w, key):
+            labels, fit_w, bag_w = _pseudo_residuals_and_weights(
+                loss, updates, y_enc, pred, bag_w, w,
+                goss=goss, goss_key=jax.random.fold_in(key, 7),
+            )
+            Y = labels[:, :, None]  # [n, dim, 1]
+            _, y_mean, vals = stream_vals_prep(Y, fit_w)
+            vals_p = jnp.pad(
+                vals, ((0, S * R - n), (0, 0), (0, 0))
+            ).reshape(S, R, dim, 2)
+            return vals_p, y_mean, bag_w
+
+        return jax.jit(run)
+
+    def build_update():
+        def run(y_enc, pred, directions, bag_w, alpha_ws, scale):
+            if optimized:
+                def phi(a):
+                    return jnp.sum(
+                        bag_w
+                        * loss.loss(y_enc, pred + a[None, :] * directions)
+                    )
+
+                if loss.has_hessian:
+                    gh = lambda a: loss.linesearch_grad_hess(
+                        y_enc, pred + a[None, :] * directions, directions,
+                        bag_w,
+                    )
+                else:
+                    gh = None
+                alpha_opt = projected_newton_box(
+                    phi, alpha_ws, max_iter=min(max_iter, 25), tol=tol,
+                    grad_hess=gh,
+                )
+            else:
+                alpha_opt = jnp.ones((dim,), jnp.float32)
+            weight = jnp.where(scale > 0, lr * alpha_opt * scale, 0.0)
+            new_pred = pred + jnp.where(
+                scale > 0, weight[None, :] * directions, 0.0
+            )
+            alpha_carry = jnp.where(
+                jnp.isfinite(alpha_opt), alpha_opt,
+                jnp.ones_like(alpha_opt),
+            )
+            return weight, new_pred, alpha_carry
+
+        return jax.jit(run)
+
+    def build_val():
+        def run(params, X_val, pred_val, y_enc_val, weight, scale):
+            dirs_val = jax.vmap(
+                lambda p: base.predict_fn(p, X_val)
+            )(params).T
+            new_pred_val = pred_val + jnp.where(
+                scale > 0, weight[None, :] * dirs_val, 0.0
+            )
+            err = jnp.mean(loss.loss(y_enc_val, new_pred_val))
+            return err, new_pred_val
+
+        return jax.jit(run)
+
+    prep = cached_program(stream_key + ("prep", n, R), build_prep)
+    upd = cached_program(stream_key + ("update",), build_update)
+    valp = cached_program(stream_key + ("val",), build_val)
+    dirp = _dir_cls_prog(n)
+    eval_loss = cached_program(
+        ("gbm_cls_eval", loss_name, num_classes),
+        lambda: jax.jit(
+            lambda pred_v, y_enc_v: jnp.mean(loss.loss(y_enc_v, pred_v))
+        ),
+    )
+
+    best = 0.0
+    pred_val = None
+    nv_pad = 0
+    if with_validation:
+        pred_val = jnp.broadcast_to(
+            init_raw[None, :], (X_val.shape[0], dim)
+        ).astype(jnp.float32)
+        best = float(eval_loss(pred_val, y_enc_val))
+        nv_pad = X_val.shape[0]
+
+    members_chunks: List[Any] = []
+    weights_chunks: List[Any] = []
+    val_history: List[float] = []
+    i, v = 0, 0
+    alpha_ws = jnp.ones((dim,), jnp.float32)
+
+    ckpt = est._checkpointer(n, d, num_classes, n, nv_pad, telem=telem)
+    resumed = ckpt.load_latest()
+    if resumed is not None:
+        last_round, st = resumed
+        detail = ckpt.last_load_detail or {}
+        telem.emit(
+            "resume_from_checkpoint",
+            round=last_round + 1,
+            source=detail.get("source", "latest"),
+            fallback=bool(detail.get("fallback", False)),
+        )
+        i, v, best = last_round + 1, int(st["v"]), float(st["best"])
+        val_history[:] = [
+            float(x) for x in np.asarray(st.get("val_hist", []))
+        ]
+        if "alpha_ws" in st:
+            alpha_ws = jnp.asarray(st["alpha_ws"])
+        pred = jnp.asarray(st["pred"])
+        pred_val = st.get("pred_val")
+        if pred_val is not None:
+            pred_val = jnp.asarray(pred_val)
+        members_chunks, weights_chunks = est._resume_chunks(st)
+        logger.info("GBMClassifier streaming resume from round %d", i)
+
+    def save_state(round_idx, v, best):
+        if not ckpt.should_save(round_idx):
+            return
+        ckpt.save(
+            round_idx,
+            {
+                "v": v,
+                "best": best,
+                "val_hist": jnp.asarray(val_history, jnp.float32),
+                "pred": pred,
+                "pred_val": pred_val,
+                "alpha_ws": alpha_ws,
+                "members_layout": est.MEMBERS_LAYOUT,
+                "members": concat_pytrees(members_chunks),
+                "weights": concat_pytrees(weights_chunks),
+            },
+        )
+
+    prefetch = ShardPrefetcher(store, telem=telem)
+    try:
+        def run_chunk(sl, step_scale=1.0):
+            nonlocal pred, pred_val, alpha_ws
+            c = sl.stop - sl.start
+            bag_c = bag_many(bag_keys[sl])
+            keys_c, masks_c = bag_keys[sl], masks[sl]
+            params_l, weights_l, errs_l = [], [], []
+            for j in range(c):
+                r = sl.start + j
+                scale = np.float32(step_scale)
+                vals_p, y_mean, bag_w = prep(
+                    y_enc, w, pred, bag_c[j], keys_c[j]
+                )
+                forest, node_all = _sweep_forest(
+                    prefetch, ctl, f"GBMClassifier:stream_round:{r}",
+                    vals_p, y_mean, masks_c[j], thresholds,
+                    max_depth=max_depth, B=B, bits=bits, d=d, prec=prec,
+                    min_gain=min_gain,
+                )
+                directions = dirp(node_all, forest.leaf_value)
+                weight, pred, alpha_ws = upd(
+                    y_enc, pred, directions, bag_w, alpha_ws, scale
+                )
+                if with_validation:
+                    err, pred_val = valp(
+                        forest, X_val, pred_val, y_enc_val, weight, scale
+                    )
+                    errs_l.append(err)
+                params_l.append(forest)
+                weights_l.append(weight)
+                _emit_shard_io(telem, prefetch)
+            params_c = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *params_l
+            )
+            weights_c = jnp.stack(weights_l)
+            errs = jnp.stack(errs_l) if with_validation else None
+            return params_c, weights_c, errs
+
+        def snapshot():
+            return pred, pred_val, alpha_ws
+
+        def restore(snap):
+            nonlocal pred, pred_val, alpha_ws
+            pred, pred_val, alpha_ws = snap
+
+        telem.phase_mark("setup")
+        i, v, best = est._drive_rounds(
+            ckpt, members_chunks, weights_chunks,
+            run_chunk, save_state, "GBMClassifier", i, v, best,
+            val_history=val_history, telem=telem,
+            guard=est._numeric_guard(telem),
+            snapshot=snapshot, restore=restore, n_rows=n,
+            round_cost=_round_cost(base, n, d, dim),
+        )
+    finally:
+        prefetch.close()
+    ckpt.delete()
+
+    keep = i - v
+    instr.log_outcome(rounds=i, kept_members=keep)
+    all_members = concat_pytrees(members_chunks) if members_chunks else None
+    all_weights = (
+        jnp.concatenate(weights_chunks) if weights_chunks else None
+    )
+    model = GBMClassificationModel(
+        params={
+            "members": slice_pytree(all_members, keep) if keep > 0 else None,
+            "weights": all_weights[:keep]
+            if keep > 0
+            else jnp.zeros((0, dim)),
+            "masks": masks[:keep],
+            "init_raw": init_raw,
+            "val_hist": jnp.asarray(val_history, jnp.float32)
+            if with_validation
+            else None,
+        },
+        num_features=d,
+        num_classes=num_classes,
+        num_members=keep,
+        dim=dim,
+        **est.get_params(),
+    )
+    telem.finish(model=model, rounds=i, kept_members=keep)
+    return model

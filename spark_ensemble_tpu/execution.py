@@ -1,7 +1,15 @@
-"""Lookahead dispatch pipeline: depth resolution + on-device patience.
+"""Round execution: the family-agnostic RoundExecutor, lookahead depth
+resolution, and the opt-in on-device patience recurrence.
 
-The round drivers (``models/gbm.py:_drive_rounds``,
-``models/boosting.py:_drive_boosting_rounds``) historically read every
+:class:`RoundExecutor` is the ONE speculative round-loop driver.  Both
+round drivers (``models/gbm.py:_drive_rounds``,
+``models/boosting.py:_drive_boosting_rounds``) and the out-of-core
+streaming fit (``data/streaming.py``) plug into it through
+:class:`RoundAdapter`; the executor owns window fill, in-order commit
+and in-flight invalidation, while each family keeps its own chunk math,
+guard recovery and checkpoint payloads behind the adapter hooks.
+
+The round drivers historically read every
 chunk's outputs back to the host *before* dispatching the next chunk, so
 the device idled during patience stepping, guard scans, telemetry fences
 and checkpoint bookkeeping — the dispatch-bound regime the only on-chip
@@ -32,7 +40,8 @@ that is why it is opt-in and OFF by default (docs/pipeline.md).
 from __future__ import annotations
 
 import os
-from typing import Optional, Tuple
+from collections import deque
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -139,3 +148,103 @@ def device_patience_step(
         telem.blocking_read(out)
     best_h, v_h, done_h, kept_h = jax.device_get(out)
     return float(best_h), int(v_h), bool(done_h), int(kept_h)
+
+
+# ---------------------------------------------------------------------------
+# the family-agnostic round executor
+# ---------------------------------------------------------------------------
+
+
+class RoundAdapter:
+    """One ensemble family's view of its round loop, as seen by
+    :class:`RoundExecutor`.
+
+    The executor owns ONLY the speculation machinery — window fill,
+    in-order commit, invalidation of in-flight chunks — which is the part
+    `gbm._drive_rounds` and `boosting._drive_boosting_rounds` used to
+    duplicate.  Everything family-specific (what a chunk dispatch returns,
+    patience vs abort-replay bookkeeping, guard recovery, checkpoint
+    payloads) lives behind these hooks:
+
+    - ``should_continue()``: loop predicate over COMMITTED state (round
+      count, patience, abort/halt flags).
+    - ``can_launch()``: whether the dispatch frontier has rounds left to
+      speculate on.
+    - ``window()``: in-flight chunk cap for the next fill — normally
+      ``depth + 1``; families with a probe chunk (boosting's abort ramp)
+      return 1 until the probe commits.
+    - ``launch() -> entry``: plan one chunk at the frontier (remaining
+      rounds, checkpoint-boundary clamp), dispatch it asynchronously, and
+      advance the frontier.  The returned entry is opaque to the executor.
+    - ``commit(entry, speculated) -> bool``: read the chunk's outputs and
+      run the family's bookkeeping.  ``speculated`` is True when further
+      chunks are still in flight (the family must then commit under the
+      entry's own carry snapshot, not the speculative frontier).  Return
+      True to INVALIDATE everything still in flight — a mid-chunk stop,
+      an abort, or a guard rewind dispatched those chunks for rounds that
+      no longer exist; the executor discards them unread and calls
+      ``reset_frontier()``.  Replay stays bit-identical because member
+      keys/masks derive from absolute round indices.
+    - ``reset_frontier()``: rewind the dispatch frontier (and any carried
+      frontier state, e.g. boosting's weight future) to committed state.
+    - ``finish()``: post-loop join (the drivers' ``ckpt.wait()``); runs
+      only on a clean exit so a ``raise`` guard policy propagates.
+    """
+
+    #: lookahead depth (chunks in flight past the committing one); 0 pins
+    #: the fully synchronous pre-pipeline path
+    depth: int = 0
+
+    def should_continue(self) -> bool:
+        raise NotImplementedError
+
+    def can_launch(self) -> bool:
+        raise NotImplementedError
+
+    def window(self) -> int:
+        return self.depth + 1
+
+    def launch(self) -> Any:
+        raise NotImplementedError
+
+    def commit(self, entry: Any, speculated: bool) -> bool:
+        raise NotImplementedError
+
+    def reset_frontier(self) -> None:
+        raise NotImplementedError
+
+    def finish(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+
+class RoundExecutor:
+    """The single round-loop driver every family routes through.
+
+    Fills the adapter's lookahead window with asynchronously dispatched
+    chunks, commits them strictly in dispatch order, and on invalidation
+    discards the speculative tail unread.  With ``depth == 0`` the fill
+    never exceeds one chunk, which reproduces the historical synchronous
+    drivers exactly (pinned by tests/test_pipeline_exec.py); with
+    ``depth > 0`` the device computes chunk ``j+1`` while the host reads
+    chunk ``j`` (docs/pipeline.md)."""
+
+    def __init__(self, adapter: RoundAdapter):
+        self.adapter = adapter
+
+    def run(self) -> RoundAdapter:
+        a = self.adapter
+        pending: deque = deque()
+        while a.should_continue():
+            while a.can_launch() and len(pending) < max(1, a.window()):
+                pending.append(a.launch())
+            if not pending:
+                # frontier exhausted with nothing in flight: only an
+                # adapter whose committed state lags its own frontier can
+                # get here, and committing is impossible — stop cleanly
+                break
+            entry = pending.popleft()
+            if a.commit(entry, speculated=bool(pending)):
+                pending.clear()
+                a.reset_frontier()
+        a.finish()
+        return a

@@ -45,7 +45,6 @@ from __future__ import annotations
 
 import logging
 import time
-from collections import deque
 from typing import Any, List, Optional
 
 import jax
@@ -235,8 +234,8 @@ class _BoostingParams(CheckpointableParams, Estimator):
                 extras = np.asarray(extras)
             return sum_bws, extras
 
-        def commit(i, c, keys, bw_prev, t_chunk,
-                   params_c, est_ws, sum_bws, bw_out, extras):
+        def commit_chunk(i, c, keys, bw_prev, t_chunk,
+                         params_c, est_ws, sum_bws, bw_out, extras):
             """One dispatched chunk's host bookkeeping (guard scan, abort
             replay, telemetry, slice-append, gated save, preemption point)
             -> (i, bw, stop, rewound)."""
@@ -320,77 +319,70 @@ class _BoostingParams(CheckpointableParams, Estimator):
                 ctl.preempt(f"{label}:after_round:{i}")
             return i, bw, stop, rewound
 
-        if depth == 0:
-            # synchronous path: one chunk in flight, outputs read before
-            # the next dispatch (pinned by tests/test_pipeline_exec.py)
-            while i < self.num_base_learners and not stop:
-                c = min(cur, self.num_base_learners - i)
-                cur = chunk  # probe survived (or no probe): full chunks now
+        # -- the family adapter behind the shared RoundExecutor: chunk j+1
+        # is enqueued on chunk j's weight futures before any host read of
+        # chunk j.  An abort, a guard rewind or a weight-mass stop
+        # invalidates everything still in flight (speculative outputs are
+        # discarded unread; fold_in keys derive from absolute round
+        # indices, so any replay is bit-identical).  The probe chunk
+        # commits alone first — it exists because round-0 aborts are the
+        # common case, and speculating past it would waste a full chunk on
+        # every such abort.
+        drv = self
+
+        class _Adapter(_execution.RoundAdapter):
+            def __init__(self):
+                self.depth = depth
+                self.i, self.bw, self.stop = i, bw, stop
+                self.i_disp = i
+                self.bw_frontier = bw
+                self.cur = cur
+                self.probe_pending = probe
+
+            def should_continue(self):
+                return self.i < drv.num_base_learners and not self.stop
+
+            def can_launch(self):
+                return self.i_disp < drv.num_base_learners
+
+            def window(self):
+                return 1 if self.probe_pending else self.depth + 1
+
+            def launch(self):
+                c = min(self.cur, drv.num_base_learners - self.i_disp)
+                self.cur = chunk  # probe planned (or no probe): full chunks
                 if ckpt.enabled:
-                    c = min(c, ckpt.rounds_until_save(i))
+                    c = min(c, ckpt.rounds_until_save(self.i_disp))
                 keys = jax.vmap(lambda j: jax.random.fold_in(root, j))(
-                    jnp.arange(i, i + c)
+                    jnp.arange(self.i_disp, self.i_disp + c)
                 )
-                t_chunk = time.perf_counter()
-                bw_prev = bw
-                params_c, est_ws, sum_bws, bw_out, extras = dispatch(
-                    keys, bw, i
-                )
-                i, bw, stop, _ = commit(
-                    i, c, keys, bw_prev, t_chunk,
+                t0 = time.perf_counter()
+                bw_prev = self.bw_frontier
+                out = dispatch(keys, bw_prev, self.i_disp)
+                entry = (self.i_disp, c, keys, bw_prev, t0) + out
+                self.i_disp += c
+                self.bw_frontier = out[3]
+                return entry
+
+            def commit(self, entry, speculated):
+                self.probe_pending = False
+                (i0, c, keys, bw_prev, t0,
+                 params_c, est_ws, sum_bws, bw_out, extras) = entry
+                self.i, self.bw, self.stop, rewound = commit_chunk(
+                    i0, c, keys, bw_prev, t0,
                     params_c, est_ws, sum_bws, bw_out, extras,
                 )
-            # join the in-flight async save before the model is assembled
-            ckpt.wait()
-            return i
+                return rewound or self.stop
 
-        # -- lookahead pipeline: chunk j+1 is enqueued on chunk j's weight
-        # futures before any host read of chunk j.  An abort, a guard
-        # rewind or a weight-mass stop invalidates everything still in
-        # flight (speculative outputs are discarded unread; fold_in keys
-        # derive from absolute round indices, so any replay is
-        # bit-identical).  The probe chunk commits alone first — it exists
-        # because round-0 aborts are the common case, and speculating past
-        # it would waste a full chunk on every such abort.
-        pending: deque = deque()
-        i_disp = i
-        bw_frontier = bw
-        probe_pending = probe
+            def reset_frontier(self):
+                self.i_disp = self.i
+                self.bw_frontier = self.bw
 
-        def speculate():
-            nonlocal i_disp, bw_frontier, cur
-            c = min(cur, self.num_base_learners - i_disp)
-            cur = chunk
-            if ckpt.enabled:
-                c = min(c, ckpt.rounds_until_save(i_disp))
-            keys = jax.vmap(lambda j: jax.random.fold_in(root, j))(
-                jnp.arange(i_disp, i_disp + c)
-            )
-            t0 = time.perf_counter()
-            bw_prev = bw_frontier
-            out = dispatch(keys, bw_prev, i_disp)
-            pending.append((i_disp, c, keys, bw_prev, t0) + out)
-            i_disp += c
-            bw_frontier = out[3]
+            def finish(self):
+                # join the in-flight async save before the model assembles
+                ckpt.wait()
 
-        while i < self.num_base_learners and not stop:
-            window = 1 if probe_pending else depth + 1
-            while i_disp < self.num_base_learners and len(pending) < window:
-                speculate()
-            (i0, c, keys, bw_prev, t0,
-             params_c, est_ws, sum_bws, bw_out, extras) = pending.popleft()
-            probe_pending = False
-            i, bw, stop, rewound = commit(
-                i0, c, keys, bw_prev, t0,
-                params_c, est_ws, sum_bws, bw_out, extras,
-            )
-            if rewound or stop:
-                pending.clear()
-                i_disp = i
-                bw_frontier = bw
-        # join the in-flight async save before the model is assembled
-        ckpt.wait()
-        return i
+        return _execution.RoundExecutor(_Adapter()).run().i
 
 
 class BoostingClassifier(_BoostingParams):

@@ -52,7 +52,6 @@ from __future__ import annotations
 
 import logging
 import time
-from collections import deque
 from typing import Any, List, Optional
 
 import jax
@@ -492,31 +491,67 @@ class _GBMParams(CheckpointableParams, Estimator):
             i, v, best, _ = process(i, 1, t0, p1, w1, e1, v, best)
             return i, v, best, False
 
-        halt = False
-        if depth == 0:
-            # the synchronous path, kept verbatim: every chunk's outputs
-            # are read before the next chunk is enqueued (pinned
-            # bit-identical by tests/test_pipeline_exec.py)
-            while (
-                not halt and i < self.num_base_learners
-                and v < self.num_rounds
-            ):
-                c = min(chunk, self.num_base_learners - i)
+        # -- the family adapter behind the shared RoundExecutor ------------
+        #
+        # (docs/pipeline.md) With ``depth == 0`` the executor never holds
+        # more than one chunk in flight, reproducing the historical fully
+        # synchronous driver (pinned bit-identical by
+        # tests/test_pipeline_exec.py).  With ``depth > 0`` each pending
+        # entry carries TWO carry snapshots: ``snap_pre`` (chunk start —
+        # the guard's rewind point) and ``snap_post`` (chunk end — the
+        # state ``save_state`` must see, so a speculative chunk is never
+        # persisted before its predecessor's bookkeeping commits).
+        drv = self
+
+        class _Adapter(_execution.RoundAdapter):
+            def __init__(self):
+                self.depth = depth
+                self.i, self.v, self.best = i, v, best
+                self.halt = False
+                self.i_disp = i  # dispatch frontier (absolute round index)
+
+            def should_continue(self):
+                return (
+                    not self.halt
+                    and self.i < drv.num_base_learners
+                    and self.v < drv.num_rounds
+                )
+
+            def can_launch(self):
+                return self.i_disp < drv.num_base_learners
+
+            def launch(self):
+                c = min(chunk, drv.num_base_learners - self.i_disp)
                 if ckpt.enabled:
-                    # end the chunk exactly on the next save boundary: keeps
-                    # periodic saves firing at any resume offset, including a
-                    # resume under a CHANGED checkpoint_interval
-                    c = min(c, ckpt.rounds_until_save(i))
-                snap = (
+                    # end the chunk exactly on the next save boundary:
+                    # keeps periodic saves firing at any resume offset,
+                    # including a resume under a CHANGED checkpoint_interval
+                    c = min(c, ckpt.rounds_until_save(self.i_disp))
+                snap_pre = (
                     snapshot()
                     if (guard_on and snapshot is not None)
                     else None
                 )
-                t_chunk = time.perf_counter()
-                params_c, weights_c, errs = dispatch(slice(i, i + c))
+                t0 = time.perf_counter()
+                params_c, weights_c, errs = dispatch(
+                    slice(self.i_disp, self.i_disp + c)
+                )
+                # the end-of-chunk snapshot only matters when later chunks
+                # can speculate past this one
+                snap_post = snapshot() if self.depth > 0 else None
+                entry = (
+                    self.i_disp, c, snap_pre, snap_post, t0,
+                    params_c, weights_c, errs,
+                )
+                self.i_disp += c
+                return entry
+
+            def commit(self, entry, speculated):
+                (i0, c, snap_pre, snap_post, t0,
+                 params_c, weights_c, errs) = entry
                 if telem is not None and telem.enabled:
                     # host-blocked accounting (pure fence — no math): the
-                    # wait this pipeline exists to overlap, measured so the
+                    # wait the pipeline exists to overlap, measured so the
                     # A/B is observable rather than inferred
                     telem.blocking_read((params_c, weights_c, errs))
                 bad = (
@@ -524,97 +559,52 @@ class _GBMParams(CheckpointableParams, Estimator):
                     if guard_on
                     else None
                 )
+                invalidate = False
                 if bad is None:
-                    i, v, best, _ = process(
-                        i, c, t_chunk, params_c, weights_c, errs, v, best
+                    frontier = snapshot() if speculated else None
+                    if speculated:
+                        # commit under the chunk's own end-state so
+                        # save_state persists committed arrays, not the
+                        # speculative frontier
+                        restore(snap_post)
+                    self.i, self.v, self.best, stopped = process(
+                        i0, c, t0, params_c, weights_c, errs,
+                        self.v, self.best,
                     )
+                    if stopped:
+                        # mid-chunk validation stop: in-flight chunks were
+                        # dispatched for rounds that no longer exist
+                        invalidate = True
+                    elif speculated:
+                        restore(frontier)
                 else:
-                    i, v, best, halt = recover(
-                        i, c, bad, snap, params_c, weights_c, errs, v, best
+                    if speculated:
+                        # rewind to the sync-equivalent carry (this chunk's
+                        # dispatch output) before recovery; the speculative
+                        # chunks built on the poisoned state are dropped
+                        restore(snap_post)
+                    self.i, self.v, self.best, self.halt = recover(
+                        i0, c, bad, snap_pre, params_c, weights_c, errs,
+                        self.v, self.best,
                     )
+                    invalidate = True
                 # chaos: a mid-training preemption lands here — after the
-                # chunk's periodic save, so kill-and-resume tests exercise a
-                # real checkpoint boundary
-                ctl.preempt(f"{label}:after_round:{i}")
-            # the loop must not end with a dangling background write: join
-            # the in-flight async save (and surface its failure) before the
-            # model is assembled
-            ckpt.wait()
-            return i, v, best
+                # chunk's periodic save, so kill-and-resume tests exercise
+                # a real checkpoint boundary
+                ctl.preempt(f"{label}:after_round:{self.i}")
+                return invalidate
 
-        # -- lookahead pipeline (docs/pipeline.md) -------------------------
-        #
-        # Up to ``depth`` chunks stay enqueued past the one being committed:
-        # dispatch is async, so the device computes chunk j+1 while the host
-        # reads chunk j.  Each pending entry carries TWO carry snapshots:
-        # ``snap_pre`` (chunk start — the guard's rewind point) and
-        # ``snap_post`` (chunk end — the state ``save_state`` must see, so a
-        # speculative chunk is never persisted before its predecessor's
-        # bookkeeping commits).  A mid-chunk stop or a flagged chunk
-        # invalidates everything still in flight: speculative outputs are
-        # discarded unread and the carry rewinds; replay is bit-identical
-        # because member keys/masks derive from absolute round indices.
-        pending: deque = deque()
-        i_disp = i  # dispatch frontier (absolute round index)
+            def reset_frontier(self):
+                self.i_disp = self.i
 
-        def speculate():
-            nonlocal i_disp
-            c = min(chunk, self.num_base_learners - i_disp)
-            if ckpt.enabled:
-                c = min(c, ckpt.rounds_until_save(i_disp))
-            snap_pre = snapshot() if guard_on else None
-            t0 = time.perf_counter()
-            params_c, weights_c, errs = dispatch(slice(i_disp, i_disp + c))
-            pending.append(
-                (i_disp, c, snap_pre, snapshot(), t0,
-                 params_c, weights_c, errs)
-            )
-            i_disp += c
+            def finish(self):
+                # the loop must not end with a dangling background write:
+                # join the in-flight async save (and surface its failure)
+                # before the model is assembled
+                ckpt.wait()
 
-        while not halt and i < self.num_base_learners and v < self.num_rounds:
-            while i_disp < self.num_base_learners and len(pending) <= depth:
-                speculate()
-            i0, c, snap_pre, snap_post, t0, params_c, weights_c, errs = (
-                pending.popleft()
-            )
-            if telem is not None and telem.enabled:
-                telem.blocking_read((params_c, weights_c, errs))
-            bad = (
-                guard.first_nonfinite(params_c, weights_c, errs)
-                if guard_on
-                else None
-            )
-            if bad is None:
-                speculated = bool(pending)
-                frontier = snapshot() if speculated else None
-                if speculated:
-                    # commit under the chunk's own end-state so save_state
-                    # persists committed arrays, not the speculative frontier
-                    restore(snap_post)
-                i, v, best, stopped = process(
-                    i0, c, t0, params_c, weights_c, errs, v, best
-                )
-                if stopped:
-                    # mid-chunk validation stop: the in-flight chunks were
-                    # dispatched for rounds that no longer exist — discard
-                    pending.clear()
-                    i_disp = i
-                elif speculated:
-                    restore(frontier)
-            else:
-                if pending:
-                    # rewind to the sync-equivalent carry (this chunk's
-                    # dispatch output) before recovery, and drop the
-                    # speculative chunks built on the poisoned state
-                    pending.clear()
-                    restore(snap_post)
-                i, v, best, halt = recover(
-                    i0, c, bad, snap_pre, params_c, weights_c, errs, v, best
-                )
-                i_disp = i
-            ctl.preempt(f"{label}:after_round:{i}")
-        ckpt.wait()
-        return i, v, best
+        ad = _execution.RoundExecutor(_Adapter()).run()
+        return ad.i, ad.v, ad.best
 
 
 def _goss_multiplier(
@@ -1277,6 +1267,21 @@ class GBMRegressor(_GBMParams):
         telem.finish(model=model, rounds=i, kept_members=keep)
         return model
 
+    @instrumented_fit
+    def fit_streaming(self, store, y, sample_weight=None, X_val=None,
+                      y_val=None):
+        """Out-of-core fit over a sealed ``ShardStore`` (data/shards.py):
+        the packed bin matrix streams from disk shard-by-shard, never
+        resident on device at once — bit-identical to ``fit`` with a
+        ``hist="stream"`` base learner at matched chunk rows (see
+        data/streaming.py for the argument)."""
+        from spark_ensemble_tpu.data.streaming import fit_streaming_regressor
+
+        return fit_streaming_regressor(
+            self, store, y, sample_weight=sample_weight,
+            X_val=X_val, y_val=y_val,
+        )
+
 
 class GBMRegressionModel(RegressionModel, GBMRegressor):
     """predict = init + sum_i w_i * m_i(x)  (`GBMRegressor.scala:531-539`)."""
@@ -1879,6 +1884,18 @@ class GBMClassifier(_GBMParams):
         )
         telem.finish(model=model, rounds=i, kept_members=keep)
         return model
+
+    @instrumented_fit
+    def fit_streaming(self, store, y, sample_weight=None, X_val=None,
+                      y_val=None, num_classes=None):
+        """Out-of-core fit over a sealed ``ShardStore`` (data/shards.py);
+        see ``GBMRegressor.fit_streaming``."""
+        from spark_ensemble_tpu.data.streaming import fit_streaming_classifier
+
+        return fit_streaming_classifier(
+            self, store, y, sample_weight=sample_weight,
+            X_val=X_val, y_val=y_val, num_classes=num_classes,
+        )
 
 
 class GBMClassificationModel(ClassificationModel, GBMClassifier):

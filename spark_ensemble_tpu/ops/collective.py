@@ -49,6 +49,30 @@ def pvary_like_shard(x, axis_name: Optional[str]):
     return x
 
 
+def pzero_like_shard(x, axis_name: Optional[str]):
+    """A zeros-like loop-accumulator seed whose replication/varying type
+    matches psum outputs on EVERY shard_map tracking generation.
+
+    A plain ``jnp.zeros_like`` literal enters a ``scan``/``fori_loop``
+    carry as replicated, but a body that adds psum-ed state to it makes
+    the carry's output varying — and shard_map rejects carries whose
+    in/out types disagree.  On vma-era jax the fix is ``pvary``
+    (:func:`pvary_like_shard`); on check_rep-era jax (no pvary/pcast) a
+    ``psum`` of the zeros is value-identical (zero summed over shards is
+    zero) and carries the collective's replication set.
+    """
+    import jax.numpy as jnp
+
+    z = jnp.zeros_like(x)
+    if axis_name is None:
+        return z
+    if getattr(jax.lax, "pcast", None) is not None or getattr(
+        jax.lax, "pvary", None
+    ) is not None:
+        return pvary_like_shard(z, axis_name)
+    return preduce(z, axis_name)
+
+
 def pmin_reduce(x, axis_name: Optional[str]):
     """``pmin`` over ``axis_name`` inside shard_map; identity when unsharded
     (brackets the distributed quantile refinement, `utils/quantile.py`)."""

@@ -694,6 +694,121 @@ def predict_chunked_rows(fn, Xq, n_members, leaves):
     return out.reshape((nc * chunk,) + out.shape[2:])[:n]
 
 
+def stream_vals_prep(Y, w, axis_name=None):
+    """Stream-tier target preparation -> ``(w_tot, y_mean, vals)``.
+
+    ``vals[n, M, 1+k]`` concatenates the per-row weight channel with the
+    weighted, root-mean-centered target channels — the compact per-row
+    statistic the chunked histogram bodies contract.  Shared by the
+    resident scan (``_fit_forest_streamed``) and the out-of-core shard
+    plane (``data/streaming.py``) so the two compute the SAME ops on the
+    same operands — bit-identity by construction, not by tolerance."""
+    w = w.astype(jnp.float32)
+    w_tot = _preduce(jnp.sum(w, axis=0), axis_name)  # [M]
+    y_mean = _preduce(
+        jnp.sum(w[:, :, None] * Y, axis=0), axis_name
+    ) / jnp.maximum(w_tot[:, None], 1e-30)  # [M, k]
+    vals = jnp.concatenate(
+        [w[:, :, None], w[:, :, None] * (Y - y_mean[None, :, :])], axis=2
+    )  # [n, M, 1+k]
+    return w_tot, y_mean, vals
+
+
+def stream_level_step(
+    acc, xb, nd, vl, *, n_nodes, tables, max_bins, stat_prec, route_prec
+):
+    """One row chunk's contribution to one level's histogram: route the
+    chunk through the PREVIOUS level's split tables and matmul-accumulate
+    into ``acc [M, n_nodes, 1+k, d, B]`` -> ``(acc, nd)``.
+
+    This is the stream tier's scan body, extracted so the resident
+    ``lax.scan`` and the per-shard programs of ``data/streaming.py`` run
+    literally the same contraction at the same precision — a shard sweep
+    accumulates ``acc`` across program calls in the same sequential order
+    the scan does, so the histograms are bitwise equal."""
+    chunk, d = xb.shape
+    _, M, C = vl.shape
+    if tables is not None:
+        nd = _route_members(
+            xb, nd, tables[0], tables[1], n_nodes // 2, route_prec
+        )
+    node_oh = jax.nn.one_hot(nd, n_nodes, dtype=jnp.float32)
+    bin_oh = _bin_one_hot(xb, max_bins)
+    A = (node_oh[:, :, :, None] * vl[:, :, None, :]).reshape(
+        chunk, M * n_nodes * C
+    )
+    acc = acc + jax.lax.dot_general(
+        A.T,
+        bin_oh,
+        (((1,), (0,)), ((), ())),
+        precision=_stat_precision_vs_onehot(stat_prec),
+    ).reshape(M, n_nodes, C, d, max_bins)
+    return acc, nd
+
+
+def stream_leaf_step(acc, xb, nd, vl, *, num_leaves, tables, stat_prec,
+                     route_prec):
+    """One row chunk's contribution to the leaf sums: route through the
+    LAST level's tables and accumulate ``acc [M, num_leaves, 1+k]`` ->
+    ``(acc, nd)``.  Shared with ``data/streaming.py`` (see
+    ``stream_level_step``)."""
+    nd = _route_members(
+        xb, nd, tables[0], tables[1], num_leaves // 2, route_prec
+    )
+    leaf_oh = jax.nn.one_hot(nd, num_leaves, dtype=jnp.float32)
+    acc = acc + jnp.einsum(
+        "nml,nmc->mlc", leaf_oh, vl,
+        precision=_stat_precision_vs_onehot(stat_prec)[::-1],
+    )
+    return acc, nd
+
+
+def stream_level_update(
+    H, feature_mask, min_info_gain, thresholds, max_bins, stat_prec, level,
+    parent_value, split_feature, split_bin, split_threshold, split_gain,
+):
+    """Score one level's (already psum-ed) histograms and write its heap
+    rows -> ``(tables, parent_value, split_feature, split_bin,
+    split_threshold, split_gain)`` where ``tables = (best_f, best_t)``
+    routes the NEXT scan/sweep.  Shared with ``data/streaming.py``."""
+    M, n_nodes = H.shape[0], H.shape[1]
+    node_floor = jnp.full((M, n_nodes), 1e-12, jnp.float32)
+    best_f, best_t, thr, do_split, best_gain, node_w, node_wy = (
+        _level_split_tables(
+            H, feature_mask, node_floor, min_info_gain, thresholds,
+            max_bins, stat_prec, "stream",
+        )
+    )
+    heap = (2**level - 1) + jnp.arange(n_nodes)
+    split_feature = split_feature.at[:, heap].set(best_f)
+    split_bin = split_bin.at[:, heap].set(best_t)
+    split_threshold = split_threshold.at[:, heap].set(thr)
+    split_gain = split_gain.at[:, heap].set(
+        jnp.where(do_split, best_gain, 0.0)
+    )
+    node_val = node_wy / jnp.maximum(node_w[:, :, None], 1e-30)
+    node_val = jnp.where(
+        node_w[:, :, None] > node_floor[:, :, None], node_val,
+        parent_value,
+    )
+    parent_value = jnp.repeat(node_val, 2, axis=1)
+    return (
+        (best_f, best_t), parent_value,
+        split_feature, split_bin, split_threshold, split_gain,
+    )
+
+
+def stream_leaf_values(leaf_w, leaf_wy, parent_value, y_mean):
+    """Leaf sums -> final leaf values (zero-weight leaves fall back to the
+    parent), re-centered at the root mean.  Shared with
+    ``data/streaming.py``."""
+    leaf_value = leaf_wy / jnp.maximum(leaf_w[:, :, None], 1e-30)
+    leaf_value = jnp.where(
+        leaf_w[:, :, None] > 1e-12, leaf_value, parent_value
+    )
+    return leaf_value + y_mean[:, None, :]
+
+
 def _fit_forest_streamed(
     Xb, Y, w, thresholds, feature_mask, *, max_depth, max_bins,
     min_info_gain, axis_name, stat_prec, route_prec, return_leaf=False,
@@ -727,14 +842,7 @@ def _fit_forest_streamed(
     preduce = lambda x: _preduce(x, axis_name)
     _pvary = lambda x: _pvary_like_shard(x, axis_name)
 
-    w = w.astype(jnp.float32)
-    w_tot = preduce(jnp.sum(w, axis=0))  # [M]
-    y_mean = preduce(jnp.sum(w[:, :, None] * Y, axis=0)) / jnp.maximum(
-        w_tot[:, None], 1e-30
-    )  # [M, k]
-    vals = jnp.concatenate(
-        [w[:, :, None], w[:, :, None] * (Y - y_mean[None, :, :])], axis=2
-    )  # [n, M, 1+k]
+    _, y_mean, vals = stream_vals_prep(Y, w, axis_name)
 
     chunk = min(_tuned("stream_chunk_rows", _STREAM_CHUNK_ROWS, n=n), n)
     nc = -(-n // chunk)
@@ -765,22 +873,10 @@ def _fit_forest_streamed(
 
         def body(acc, xs, n_nodes=n_nodes, tables=prev_tables):
             xb, nd, vl = xs
-            if tables is not None:
-                nd = _route_members(
-                    xb, nd, tables[0], tables[1], n_nodes // 2, route_prec
-                )
-            node_oh = jax.nn.one_hot(nd, n_nodes, dtype=jnp.float32)
-            bin_oh = _bin_one_hot(xb, B)
-            A = (node_oh[:, :, :, None] * vl[:, :, None, :]).reshape(
-                chunk, M * n_nodes * C
+            return stream_level_step(
+                acc, xb, nd, vl, n_nodes=n_nodes, tables=tables,
+                max_bins=B, stat_prec=stat_prec, route_prec=route_prec,
             )
-            acc = acc + jax.lax.dot_general(
-                A.T,
-                bin_oh,
-                (((1,), (0,)), ((), ())),
-                precision=_stat_precision_vs_onehot(stat_prec),
-            ).reshape(M, n_nodes, C, d, B)
-            return acc, nd
 
         H, node_c = jax.lax.scan(
             body,
@@ -789,44 +885,24 @@ def _fit_forest_streamed(
         )
         H = preduce(H)
 
-        node_floor = jnp.full((M, n_nodes), 1e-12, jnp.float32)
-        best_f, best_t, thr, do_split, best_gain, node_w, node_wy = (
-            _level_split_tables(
-                H, feature_mask, node_floor, min_info_gain, thresholds, B,
-                stat_prec, "stream",
+        (prev_tables, parent_value,
+         split_feature, split_bin, split_threshold, split_gain) = (
+            stream_level_update(
+                H, feature_mask, min_info_gain, thresholds, B, stat_prec,
+                level, parent_value,
+                split_feature, split_bin, split_threshold, split_gain,
             )
         )
-
-        heap = (2**level - 1) + jnp.arange(n_nodes)
-        split_feature = split_feature.at[:, heap].set(best_f)
-        split_bin = split_bin.at[:, heap].set(best_t)
-        split_threshold = split_threshold.at[:, heap].set(thr)
-        split_gain = split_gain.at[:, heap].set(
-            jnp.where(do_split, best_gain, 0.0)
-        )
-
-        node_val = node_wy / jnp.maximum(node_w[:, :, None], 1e-30)
-        node_val = jnp.where(
-            node_w[:, :, None] > node_floor[:, :, None], node_val,
-            parent_value,
-        )
-        parent_value = jnp.repeat(node_val, 2, axis=1)
-        prev_tables = (best_f, best_t)
 
     # final scan: route the last level, accumulate leaf sums
     num_leaves = 2**max_depth
 
     def leaf_body(acc, xs, tables=prev_tables):
         xb, nd, vl = xs
-        nd = _route_members(
-            xb, nd, tables[0], tables[1], num_leaves // 2, route_prec
+        return stream_leaf_step(
+            acc, xb, nd, vl, num_leaves=num_leaves, tables=tables,
+            stat_prec=stat_prec, route_prec=route_prec,
         )
-        leaf_oh = jax.nn.one_hot(nd, num_leaves, dtype=jnp.float32)
-        acc = acc + jnp.einsum(
-            "nml,nmc->mlc", leaf_oh, vl,
-            precision=_stat_precision_vs_onehot(stat_prec)[::-1],
-        )
-        return acc, nd
 
     L, node_c = jax.lax.scan(
         leaf_body,
@@ -835,15 +911,11 @@ def _fit_forest_streamed(
     )
     leaf_w = preduce(L[:, :, 0])  # [M, L]
     leaf_wy = preduce(L[:, :, 1:])  # [M, L, k]
-    leaf_value = leaf_wy / jnp.maximum(leaf_w[:, :, None], 1e-30)
-    leaf_value = jnp.where(
-        leaf_w[:, :, None] > 1e-12, leaf_value, parent_value
-    )
     tree = Tree(
         split_feature=split_feature,
         split_bin=split_bin,
         split_threshold=split_threshold,
-        leaf_value=leaf_value + y_mean[:, None, :],
+        leaf_value=stream_leaf_values(leaf_w, leaf_wy, parent_value, y_mean),
         split_gain=split_gain,
     )
     if return_leaf:

@@ -125,7 +125,7 @@ class TrainingCheckpointer:
         exception the synchronous path would have raised at save time)."""
         if self._pending is not None:
             pending, self._pending = self._pending, None
-            pending.result()
+            pending.result()  # graftlint: ignore[unfenced-blocking-read] -- async-save join at the save boundary, not the dispatch window; kept bare so the save thread's failure re-raises here
 
     def save(self, round_idx: int, state: Dict[str, Any]) -> None:
         if not self.enabled:

@@ -40,7 +40,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from spark_ensemble_tpu.ops.collective import pmax_reduce, pmin_reduce, preduce
+from spark_ensemble_tpu.ops.collective import (
+    pmax_reduce,
+    pmin_reduce,
+    preduce,
+    pzero_like_shard,
+)
 
 # 4 rounds x 256-bin psum-ed histograms walk the full 2^32 u32 key space
 # down to a single key: 256^4 = 2^32 exactly.
@@ -156,9 +161,11 @@ def _sharded_crossing_key(values, weights, target, axis_name) -> jax.Array:
     hi0 = _f32_keys(
         pmax_reduce(jnp.max(jnp.where(finite, values, -jnp.inf)), axis_name)
     )
-    lo, hi, _ = jax.lax.fori_loop(
-        0, _ROUNDS, body, (lo0, hi0, jnp.float32(0.0))
-    )
+    # the zero accumulator must enter the loop typed like the body's
+    # psum-ed cumulative — a replicated literal trips shard_map's carry
+    # replication check (ops/collective.py pzero_like_shard)
+    cum0 = pzero_like_shard(jnp.float32(0.0), axis_name)
+    lo, hi, _ = jax.lax.fori_loop(0, _ROUNDS, body, (lo0, hi0, cum0))
     return lo
 
 

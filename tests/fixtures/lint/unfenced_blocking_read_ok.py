@@ -17,3 +17,17 @@ def charged(model, X, telem):
     telem.blocking_read(model.predict(X))
     # arrays were fenced-and-charged above; this conversion cannot block
     return np.asarray(model.predict(X))
+
+
+def fenced_join(fut, telem):
+    # the prefetcher's shard-wait shape (data/prefetch.py): the join is
+    # timed and charged to the host-blocked ledger
+    t0 = time.perf_counter()
+    arr = fut.result()
+    telem.host_blocked(time.perf_counter() - t0)
+    return arr
+
+
+def bounded_join(fut):
+    # timeout-bounded joins (tools, tests) are outside the rule's scope
+    return fut.result(timeout=60)

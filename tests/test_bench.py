@@ -195,3 +195,61 @@ def test_run_inner_salvages_headline_from_partial_stdout(monkeypatch):
     monkeypatch.setattr(bench.subprocess, "run", lambda *a, **k: P())
     result, err = bench._run_inner(dict(), 5)
     assert result == {"value": 1.0, "platform": "tpu"}
+
+
+def test_probe_failure_is_single_and_structured(monkeypatch, capsys):
+    """A dead accelerator costs ONE probe (no backoff spam) and the JSON
+    carries a machine-readable tpu_unavailable record, not joined retry
+    strings (BENCH_r05 burned 4x240s on this)."""
+    bench = _load_bench()
+    monkeypatch.delenv("BENCH_PROBE_RETRIES", raising=False)
+    probes = []
+
+    def dead_probe(timeout_s):
+        probes.append(timeout_s)
+        return False, "backend init timed out after 240s"
+
+    monkeypatch.setattr(bench, "_probe_accelerator", dead_probe)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: (_ for _ in ()).throw(
+        AssertionError("fail-fast path must not back off")
+    ))
+
+    def fake_inner(env, t):
+        assert env.get("JAX_PLATFORMS") == "cpu"
+        return {
+            "metric": bench._METRIC, "value": 2.0, "unit": "iters/sec",
+            "platform": "cpu",
+        }, None
+
+    monkeypatch.setattr(bench, "_run_inner", fake_inner)
+    rc = bench.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert len(probes) == 1
+    tu = out["tpu_unavailable"]
+    assert tu["probes"] == 1
+    assert "timed out" in tu["reason"]
+    assert tu["probe_timeout_s"] == 240
+    assert "probe 1:" not in out.get("error", "")
+
+
+def test_probe_retries_remain_opt_in(monkeypatch, capsys):
+    bench = _load_bench()
+    monkeypatch.setenv("BENCH_PROBE_RETRIES", "3")
+    probes = []
+    monkeypatch.setattr(
+        bench, "_probe_accelerator",
+        lambda t: (probes.append(t), (False, "nope"))[1],
+    )
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(
+        bench, "_run_inner",
+        lambda env, t: ({
+            "metric": bench._METRIC, "value": 1.0, "unit": "iters/sec",
+            "platform": "cpu",
+        }, None),
+    )
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(probes) == 3
+    assert out["tpu_unavailable"]["probes"] == 3

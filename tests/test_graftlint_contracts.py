@@ -88,3 +88,15 @@ def test_serving_warmup_budget(traced):
 
     if jax.default_backend() == "cpu":
         assert "serving.donation" in traced.skipped
+
+
+def test_streaming_budgets_traced(traced):
+    # the out-of-core fits (data/streaming.py) pin a FIXED program
+    # inventory: the tracer runs each family at two shard counts and
+    # appends a "streaming" violation if the count grows, so an empty
+    # violation list (asserted above) IS the no-new-programs-per-shard
+    # contract; here pin that the budgets landed and are shard-free
+    assert traced.budgets["gbm_regressor.fit_streaming"] >= 1
+    assert traced.budgets["gbm_classifier.fit_streaming"] >= 1
+    assert "gbm_regressor.fit_streaming" not in traced.skipped
+    assert "gbm_classifier.fit_streaming" not in traced.skipped

@@ -317,3 +317,27 @@ def test_profiling_jsonl_mode(tmp_path, capsys):
     assert by_op["fusion.1"]["total_us"] == 400.0
     assert by_op["fusion.1"]["count"] == 2
     assert math.isclose(by_op["dot.2"]["share"], 0.6)
+
+
+def test_report_cli_renders_shard_io_line(tmp_path, capsys):
+    """Streaming fits carry shard_load/shard_prefetch_hit/shard_wait_us
+    events; the report folds them into one shard-I/O share line."""
+    from spark_ensemble_tpu.data import write_shards
+    from spark_ensemble_tpu.models.tree import DecisionTreeRegressor
+
+    path = str(tmp_path / "fit.jsonl")
+    X, y = _data()
+    store = write_shards(X, str(tmp_path / "store"), max_bins=16,
+                         shard_rows=40)
+    se.GBMRegressor(
+        num_base_learners=3, telemetry_path=path,
+        base_learner=DecisionTreeRegressor(
+            hist="stream", max_bins=16, max_depth=2
+        ),
+    ).fit_streaming(store, y)
+    report = _load_report()
+    assert report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "shard I/O:" in out
+    assert "prefetch hits" in out
+    assert "wait share" in out

@@ -135,6 +135,37 @@ def round_cost_line(fit_events: List[dict]) -> Optional[str]:
     return "  ".join(parts)
 
 
+def shard_io_line(fit_events: List[dict]) -> Optional[str]:
+    """Shard-I/O summary for streaming fits (data/streaming.py): bytes
+    pulled through the prefetcher, prefetch hit rate, and — the number the
+    prefetcher exists to minimize — the shard_wait share of wall (host
+    time spent waiting on a shard the worker had not finished loading)."""
+    loads = [e for e in fit_events if e.get("event") == "shard_load"]
+    if not loads:
+        return None
+    hits = [e for e in fit_events if e.get("event") == "shard_prefetch_hit"]
+    waits = [e for e in fit_events if e.get("event") == "shard_wait_us"]
+    n_loads = sum(int(e.get("count", 0)) for e in loads)
+    total_bytes = sum(int(e.get("bytes", 0)) for e in loads)
+    load_s = sum(float(e.get("duration_us", 0.0)) for e in loads) / 1e6
+    wait_s = sum(float(e.get("wait_us", 0.0)) for e in waits) / 1e6
+    n_hits = sum(int(e.get("hits", 0)) for e in hits)
+    n_total = n_hits + sum(int(e.get("misses", 0)) for e in hits)
+    parts = [
+        f"shard I/O: {n_loads} loads  {total_bytes / 2**20:.2f} MiB  "
+        f"load {load_s * 1e3:.1f}ms  wait {wait_s * 1e3:.1f}ms"
+    ]
+    if n_total:
+        parts.append(f"prefetch hits {100.0 * n_hits / n_total:.1f}%")
+    fit_end = next(
+        (e for e in fit_events if e.get("event") == "fit_end"), None
+    )
+    wall_s = float(fit_end.get("wall_s", 0.0)) if fit_end else 0.0
+    if wall_s > 0:
+        parts.append(f"wait share {100.0 * wait_s / wall_s:.1f}% of wall")
+    return "  ".join(parts)
+
+
 def render_fit(fit_id: str, fit_events: List[dict]) -> str:
     lines = [f"== {fit_id} =="]
     start = next(
@@ -189,6 +220,9 @@ def render_fit(fit_id: str, fit_events: List[dict]) -> str:
     cost = round_cost_line(fit_events)
     if cost:
         lines.append(cost)
+    shard_io = shard_io_line(fit_events)
+    if shard_io:
+        lines.append(shard_io)
     probe = next(
         (e for e in fit_events if e.get("event") == "phase_probe"), None
     )
