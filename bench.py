@@ -551,6 +551,137 @@ def _bench_xl_extras():
         return {"xl_error": str(e)[:200]}
 
 
+def _bench_fleet(model, X, y, num_rounds):
+    """Fleet load-generator leg (docs/fleet.md): closed-loop batteries
+    against the replicated router at 0 and 1 injected replica faults, plus
+    a skewed two-model open-loop.  The resilience evidence rides the BENCH
+    json: a replica killed under load fails ZERO requests ("failed" in the
+    faulted leg) and the faulted p99 stays within small multiples of the
+    clean leg ("p99_fault_ratio").  Failures recorded, not fatal."""
+    import threading as _th
+
+    import numpy as np
+
+    from spark_ensemble_tpu import GBMClassifier
+    from spark_ensemble_tpu.serving import FleetRouter, InferenceEngine
+
+    try:
+        tier = max(1, num_rounds // 4)
+        req_rows, n_req, n_threads = 32, 96, 4
+        reqs = [
+            np.asarray(X[(i * 131) % (X.shape[0] - req_rows) :][:req_rows])
+            for i in range(n_req)
+        ]
+        # ONE warmed engine feeds every leg: replicas are clones sharing
+        # its AOT programs, so the fleets below add zero compile cost
+        base = InferenceEngine(
+            model, prefix_tiers=(tier,), min_bucket=32, max_batch_size=256,
+            label="bench-fleet",
+        )
+
+        def _closed_loop(kill_at=None):
+            failed = [0]
+
+            def _run(fleet):
+                def worker(tid):
+                    for i in range(tid, n_req, n_threads):
+                        if kill_at is not None and tid == 0 and i == kill_at:
+                            fleet.kill_replica()
+                        try:
+                            fleet.predict(reqs[i], deadline_ms=10_000.0)
+                        except Exception:  # noqa: BLE001 - counted, not fatal
+                            failed[0] += 1
+
+                threads = [
+                    _th.Thread(target=worker, args=(t,))
+                    for t in range(n_threads)
+                ]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=300)
+                wall = time.perf_counter() - t0
+                snap = fleet.slo_snapshot()
+                return {
+                    "qps": round(n_req / wall, 1),
+                    "p50_ms": round(snap["p50_ms"], 3),
+                    "p99_ms": round(snap["p99_ms"], 3),
+                    "failed": failed[0],
+                    "hedge_rate": round(
+                        snap["hedges_fired"] / max(snap["requests"], 1), 4
+                    ),
+                    "degraded_share": round(snap["degraded_share"], 4),
+                    "replays": snap["replays"],
+                    "crashes": snap["crashes"],
+                    "shed": snap["shed"],
+                    "compiles_after_warmup": snap["compiles_since_warmup"],
+                }
+
+            with FleetRouter(
+                base, replicas=2, deadline_ms=10_000.0, label="bench-fleet"
+            ) as fleet:
+                return _run(fleet)
+
+        clean = _closed_loop()
+        faulted = _closed_loop(kill_at=(n_req // 2 // n_threads) * n_threads)
+
+        # skewed two-model open-loop: 90% of paced submits hit the hot
+        # fleet, 10% a small cold model — the multi-model routing picture
+        small = GBMClassifier(
+            num_base_learners=5, loss="logloss", learning_rate=0.3
+        ).fit(X[:2048], y[:2048])
+        shed = [0]
+        with FleetRouter(
+            base, replicas=2, deadline_ms=10_000.0, label="bench-hot"
+        ) as hot, FleetRouter(
+            small, replicas=1, min_bucket=32, max_batch_size=256,
+            deadline_ms=10_000.0, label="bench-cold",
+        ) as cold:
+            futs = []
+            t0 = time.perf_counter()
+            for i in range(n_req):
+                target = cold if i % 10 == 9 else hot
+                try:
+                    futs.append(target.submit(reqs[i % len(reqs)]))
+                except Exception:  # noqa: BLE001 - open loop: sheds counted
+                    shed[0] += 1
+                time.sleep(0.0005)
+            for f in futs:
+                f.result(timeout=300)
+            wall = time.perf_counter() - t0
+            hsnap, csnap = hot.slo_snapshot(), cold.slo_snapshot()
+            open_loop = {
+                "qps": round(len(futs) / wall, 1),
+                "hot_p99_ms": round(hsnap["p99_ms"], 3),
+                "cold_p99_ms": round(csnap["p99_ms"], 3),
+                "hedge_rate": round(
+                    (hsnap["hedges_fired"] + csnap["hedges_fired"])
+                    / max(hsnap["requests"] + csnap["requests"], 1),
+                    4,
+                ),
+                "degraded_share": round(
+                    (hsnap["degraded"] + csnap["degraded"])
+                    / max(hsnap["requests"] + csnap["requests"], 1),
+                    4,
+                ),
+                "shed": shed[0],
+            }
+        base.stop()
+        return {
+            "replicas": 2,
+            "prefix_tier": tier,
+            "clean": clean,
+            "faulted": faulted,
+            "p99_fault_ratio": round(
+                faulted["p99_ms"] / max(clean["p99_ms"], 1e-9), 3
+            ),
+            "open_loop": open_loop,
+        }
+    except Exception as e:  # noqa: BLE001 - carry the error, keep going
+        return {"error": str(e)[:200]}
+
+
 def _block_on_model(model):
     """Block on EVERY jax array reachable from the fitted model — composite
     models (stacking, pipelines) keep their arrays in base_models /
@@ -691,6 +822,11 @@ def inner():
     engine.stop()
     serving_rows_per_sec = serve_rows / eng_small_s
     raw_small_rows_per_sec = serve_rows / raw_small_s
+
+    # resilient-fleet load generator (docs/fleet.md): QPS/p50/p99,
+    # hedge-rate, and degraded-share at 0 and 1 injected replica faults,
+    # plus a skewed two-model open-loop — the serving robustness evidence
+    fleet_stats = _bench_fleet(model, X, y, num_rounds)
 
     # telemetry overhead: re-fit with the JSONL event stream enabled —
     # telemetry_path is not part of any program-cache key, so this fit
@@ -902,6 +1038,7 @@ def inner():
             if lat else None
         ),
         "serving_compiles_after_warmup": serving_compiles,
+        "fleet": fleet_stats,
         "pipeline_speedup": pipeline_ab["speedup"],
         "pipeline": pipeline_ab,
         "fused_speedup": hist_tier_ab.get("fused_speedup"),

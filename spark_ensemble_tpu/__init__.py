@@ -110,6 +110,9 @@ from spark_ensemble_tpu.robustness import (
 )
 from spark_ensemble_tpu import serving
 from spark_ensemble_tpu.serving import (
+    FleetOverloadError,
+    FleetResponse,
+    FleetRouter,
     InferenceEngine,
     ModelRegistry,
     PackedModel,
@@ -215,6 +218,9 @@ __all__ = [
     "load_packed",
     "InferenceEngine",
     "ModelRegistry",
+    "FleetRouter",
+    "FleetResponse",
+    "FleetOverloadError",
     "TUNABLES",
     "TuningCache",
     "autotune_fit",

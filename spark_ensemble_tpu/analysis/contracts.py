@@ -482,6 +482,74 @@ def _trace_serving(report: ContractReport) -> None:
         engine.stop()
 
 
+def _trace_fleet(report: ContractReport) -> None:
+    """Trace the serving-fleet warmup contract (serving/fleet.py).
+
+    The fleet's compile budget is O(methods x buckets x (1 + prefix
+    tiers)) and **independent of the replica count** — replicas are
+    clones sharing one compiled-program map.  Steady-state fleet serving,
+    including degraded prefix-tier serves, must perform zero backend
+    compiles after warmup."""
+    from spark_ensemble_tpu.serving.fleet import FleetRouter
+    from spark_ensemble_tpu.telemetry.events import compile_snapshot
+
+    import spark_ensemble_tpu as se
+
+    X, y = _canonical_data(False)
+    model = se.GBMRegressor(
+        base_learner=se.DecisionTreeRegressor(max_depth=3),
+        num_base_learners=3,
+        seed=0,
+    ).fit(X, y)
+
+    router = FleetRouter(
+        model,
+        replicas=2,
+        methods=("predict",),
+        prefix_tiers=(2,),
+        min_bucket=8,
+        max_batch_size=32,
+    )
+    try:
+        engine = router._base
+        expected = (
+            len(engine._methods)
+            * len(engine.buckets)
+            * (1 + len(engine.prefix_tiers))
+        )
+        got = len(engine._compiled)
+        report.budgets["fleet.warmup"] = got
+        if got != expected:
+            report.violations.append(
+                ContractViolation(
+                    "serving",
+                    "fleet.warmup",
+                    f"fleet warmup compiled {got} programs, expected "
+                    "len(methods) x len(buckets) x (1 + len(prefix_tiers)) "
+                    f"= {expected} (shared across replicas)",
+                )
+            )
+        # steady state across replicas AND tiers: routed full-model and
+        # degraded prefix serves must both hit pre-warmed programs
+        before = compile_snapshot()[0]
+        for n in (1, 7, 30):
+            router.predict(X[:n])
+        for rep in router._replicas:
+            rep.engine.predict(X[:5], tier=2)
+        after = compile_snapshot()[0]
+        if after != before:
+            report.violations.append(
+                ContractViolation(
+                    "serving",
+                    "fleet.steady_state",
+                    f"{after - before} backend compile(s) during warmed "
+                    "fleet serving incl. prefix tiers (must be zero)",
+                )
+            )
+    finally:
+        router.stop()
+
+
 def _trace_streaming(report: ContractReport) -> None:
     """Trace the out-of-core streaming fit entry points (data/streaming.py).
 
@@ -576,6 +644,8 @@ def trace_contracts(
             _trace_family(name, spec, report)
         if wanted is None or "serving" in wanted:
             _trace_serving(report)
+        if wanted is None or "fleet" in wanted:
+            _trace_fleet(report)
         if wanted is None or "streaming" in wanted:
             _trace_streaming(report)
     return report

@@ -11,6 +11,7 @@ packed matrix on device — bit-identically to a resident
 
 from spark_ensemble_tpu.data.prefetch import (
     DEFAULT_PREFETCH_DEPTH,
+    ShardLoadError,
     ShardPrefetcher,
 )
 from spark_ensemble_tpu.data.shards import (
@@ -24,6 +25,7 @@ __all__ = [
     "DEFAULT_PREFETCH_DEPTH",
     "DEFAULT_SHARD_ROWS",
     "SHARD_FORMAT",
+    "ShardLoadError",
     "ShardPrefetcher",
     "ShardStore",
     "write_shards",

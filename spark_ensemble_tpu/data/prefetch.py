@@ -38,6 +38,22 @@ from spark_ensemble_tpu.autotune.resolve import resolve as _tuned
 DEFAULT_PREFETCH_DEPTH = 2
 
 
+class ShardLoadError(RuntimeError):
+    """A shard read failed on the prefetch worker thread.
+
+    Worker exceptions only surface when the consumer awaits the future —
+    potentially several shards after the one that broke.  This wrapper
+    pins the failure to its shard index (``.shard``) and keeps the
+    original exception as ``__cause__``, so a streaming-fit abort names
+    the file that failed, not the shard that happened to be awaited.  A
+    ``RuntimeError`` so the retry layer treats a flaky read like any
+    other transient fault."""
+
+    def __init__(self, shard: int, cause: BaseException):
+        super().__init__(f"shard {shard} failed to load: {cause!r}")
+        self.shard = int(shard)
+
+
 class ShardPrefetcher:
     """Cyclic single-worker shard prefetcher over a ``ShardStore``."""
 
@@ -62,6 +78,7 @@ class ShardPrefetcher:
         return {
             "loads": 0, "hits": 0, "misses": 0, "bytes": 0,
             "load_s": 0.0, "wait_s": 0.0,
+            "errors": 0, "last_error": None,
         }
 
     def _read(self, s: int) -> Tuple[np.ndarray, float]:
@@ -91,7 +108,17 @@ class ShardPrefetcher:
                 fut = self._ex.submit(self._read, pos)
             hit = fut.done()
             t0 = time.perf_counter()
-            arr, load_s = fut.result()
+            try:
+                arr, load_s = fut.result()
+            except Exception as e:
+                # attribute the abort to the shard that broke: the wait is
+                # still charged, the failure lands in take_stats(), and the
+                # consumer sees the index (not just whichever await lost)
+                st = self._stats
+                st["wait_s"] += time.perf_counter() - t0
+                st["errors"] += 1
+                st["last_error"] = f"shard {pos}: {type(e).__name__}: {e}"
+                raise ShardLoadError(pos, e) from e
             wait_s = time.perf_counter() - t0
             st = self._stats
             st["loads"] += 1
@@ -111,8 +138,9 @@ class ShardPrefetcher:
 
     def take_stats(self) -> Dict[str, float]:
         """Counters accumulated since the last take (loads / hits /
-        misses / bytes / load_s / wait_s), then reset — the per-round
-        shard-I/O telemetry reads this after each round."""
+        misses / bytes / load_s / wait_s / errors / last_error), then
+        reset — the per-round shard-I/O telemetry reads this after each
+        round."""
         out, self._stats = self._stats, self._zero_stats()
         return out
 

@@ -17,13 +17,15 @@ class systems treat recoverability as a first-class feature (arXiv
 - ``validate``: fail-fast NaN/Inf input validation at ``fit()`` entry
   (``allow_nan=True`` is the escape hatch);
 - ``chaos``: a deterministic fault injector (``SE_TPU_CHAOS``) for NaN
-  gradients, mid-round preemption, transient errors, and checkpoint
-  corruption — how all of the above is exercised in CI (docs/robustness.md).
+  gradients, mid-round preemption, transient errors, checkpoint corruption,
+  and serving-replica faults (stall / crash / slow reply) — how all of the
+  above is exercised in CI (docs/robustness.md).
 """
 
 from spark_ensemble_tpu.robustness.chaos import (
     ChaosController,
     ChaosPreemption,
+    ChaosReplicaCrash,
     ChaosTransientError,
 )
 from spark_ensemble_tpu.robustness.guards import (
@@ -37,6 +39,7 @@ from spark_ensemble_tpu.robustness.validate import validate_fit_inputs
 __all__ = [
     "ChaosController",
     "ChaosPreemption",
+    "ChaosReplicaCrash",
     "ChaosTransientError",
     "NONFINITE_POLICIES",
     "NonFiniteError",

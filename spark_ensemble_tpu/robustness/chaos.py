@@ -12,7 +12,8 @@ Environment contract (read once, cached):
 - ``SE_TPU_CHAOS``: enables injection; an integer seed (non-numeric values
   are hashed to one).  Unset/empty → no-op controller.
 - ``SE_TPU_CHAOS_FAULTS``: comma list restricting the active fault kinds
-  (subset of ``nan_grad,preempt,transient,ckpt_corrupt``; default all).
+  (subset of ``nan_grad,preempt,transient,ckpt_corrupt,replica_stall,
+  replica_crash,slow_reply``; default all).
 - ``SE_TPU_CHAOS_RATE``: per-site firing probability (default 0.05).
 - ``SE_TPU_CHAOS_LOG``: JSONL path appending one record per injected fault
   (uploaded as a CI artifact next to the telemetry stream).
@@ -35,7 +36,11 @@ from typing import Dict, Iterable, Optional, Set, Tuple
 
 logger = logging.getLogger("spark_ensemble_tpu")
 
-FAULT_KINDS = ("nan_grad", "preempt", "transient", "ckpt_corrupt")
+FAULT_KINDS = (
+    "nan_grad", "preempt", "transient", "ckpt_corrupt",
+    # serving-fleet faults (fired from FleetRouter replica workers only)
+    "replica_stall", "replica_crash", "slow_reply",
+)
 
 
 class ChaosPreemption(Exception):
@@ -47,6 +52,13 @@ class ChaosPreemption(Exception):
 class ChaosTransientError(RuntimeError):
     """Injected transient device error; a ``RuntimeError`` on purpose so
     the retry/backoff layer treats it like a real XLA hiccup."""
+
+
+class ChaosReplicaCrash(Exception):
+    """Injected serving-replica death.  Not a ``RuntimeError`` so nothing
+    between the replica worker and the fleet router can swallow it: the
+    router must observe the crash, eject the replica, and replay its queue
+    on a healthy one — exactly like a real worker-process kill."""
 
 
 class ChaosController:
@@ -79,7 +91,12 @@ class ChaosController:
         self.seed = int(seed)
         self.rate = float(rate)
         self.faults: Set[str] = set(kinds)
-        self.budgets: Dict[str, Optional[int]] = {"preempt": 1}
+        self.budgets: Dict[str, Optional[int]] = {
+            "preempt": 1,
+            # one replica death per run by default: the fleet should absorb
+            # a single kill; unbounded kills is a different experiment
+            "replica_crash": 1,
+        }
         if budgets:
             self.budgets.update(budgets)
         self.log_path = log_path
@@ -200,6 +217,26 @@ class ChaosController:
         except OSError:
             logger.exception("chaos: could not corrupt %s", state_path)
 
+    # -- serving-fleet hooks (called from FleetRouter replica workers) -----
+
+    def stall_s(self, site: str, seconds: float = 0.25) -> float:
+        """Seconds a replica worker should sleep before serving — long
+        enough to trip the router's hedge timer and the breaker's slow
+        streak, without killing the replica.  0.0 when the site does not
+        fire (the caller skips the sleep entirely)."""
+        return float(seconds) if self._fire("replica_stall", site) else 0.0
+
+    def crash(self, site: str) -> None:
+        """Raise :class:`ChaosReplicaCrash` (globally budgeted; default 1)."""
+        if self._fire("replica_crash", site):
+            raise ChaosReplicaCrash(f"chaos: replica crashed at {site}")
+
+    def slow_s(self, site: str, seconds: float = 0.05) -> float:
+        """Seconds of added reply latency — a degraded-but-alive replica
+        (slow NIC, noisy neighbor) that should push the router toward
+        hedging and prefix degradation rather than ejection."""
+        return float(seconds) if self._fire("slow_reply", site) else 0.0
+
 
 class _NoopController:
     """Injection disabled: every hook is a cheap no-op/identity."""
@@ -224,6 +261,15 @@ class _NoopController:
 
     def corrupt_checkpoint(self, site: str, state_path: str) -> None:
         pass
+
+    def stall_s(self, site: str, seconds: float = 0.25) -> float:
+        return 0.0
+
+    def crash(self, site: str) -> None:
+        pass
+
+    def slow_s(self, site: str, seconds: float = 0.05) -> float:
+        return 0.0
 
 
 _NOOP = _NoopController()

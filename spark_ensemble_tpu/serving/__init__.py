@@ -1,25 +1,35 @@
-"""Serving subsystem: packed export, bucketed AOT inference, model registry.
+"""Serving subsystem: packed export, bucketed AOT inference, model registry,
+and a fault-tolerant replicated fleet.
 
 The training side of this package ends at ``est.fit(X, y) -> model``; this
 subpackage is the inference side the ROADMAP's "serves heavy traffic" north
 star needs (the reference library stops at ``model.transform(df)`` — no
-export format, no batching, no warmup).  Three parts (docs/serving.md):
+export format, no batching, no warmup).  Four parts (docs/serving.md,
+docs/fleet.md):
 
 - :mod:`spark_ensemble_tpu.serving.export` — ``pack(model)`` compacts any
   fitted ensemble into a :class:`PackedModel` (flat dict of stacked device
   arrays + static JSON metadata) with a versioned sha256-manifested on-disk
-  artifact and **bit-identical** predictions;
+  artifact, **bit-identical** predictions, and ``take(k)`` ensemble-prefix
+  slices (bit-identical to a k-round fit);
 - :mod:`spark_ensemble_tpu.serving.engine` — :class:`InferenceEngine` pads
-  requests into power-of-two batch buckets, AOT-compiles each bucket at
-  startup (``jax.jit(...).lower().compile()``), and serves synchronously or
+  requests into power-of-two batch buckets, AOT-compiles each bucket (and
+  each configured prefix tier) at startup
+  (``jax.jit(...).lower().compile()``), and serves synchronously or
   through a micro-batching queue that coalesces many small callers into one
   device dispatch;
 - :mod:`spark_ensemble_tpu.serving.registry` — :class:`ModelRegistry`, a
-  thread-safe multi-model registry with LRU eviction of device buffers.
+  thread-safe multi-model registry with LRU eviction of device buffers and
+  pin-until-reply leases (hot-swap can never free an in-flight version);
+- :mod:`spark_ensemble_tpu.serving.fleet` — :class:`FleetRouter`, N
+  replicated engines behind health-checked queue-depth routing, hedged
+  retries under a deadline budget, per-replica circuit breakers, and
+  graceful ensemble-prefix degradation.
 
-All three emit ``model_packed`` / ``engine_warmup`` / ``request_served``
-events through :mod:`spark_ensemble_tpu.telemetry`, so
-``tools/telemetry_report.py`` renders serving traces unchanged.
+All of it emits ``model_packed`` / ``engine_warmup`` / ``request_served`` /
+``fleet_request`` / ``replica_state`` / ``fleet_slo`` events through
+:mod:`spark_ensemble_tpu.telemetry`, so ``tools/telemetry_report.py``
+renders serving traces unchanged.
 """
 
 from spark_ensemble_tpu.serving.export import (
@@ -29,6 +39,13 @@ from spark_ensemble_tpu.serving.export import (
     pack,
 )
 from spark_ensemble_tpu.serving.engine import InferenceEngine
+from spark_ensemble_tpu.serving.fleet import (
+    REPLICA_STATES,
+    FleetDeadlineError,
+    FleetOverloadError,
+    FleetResponse,
+    FleetRouter,
+)
 from spark_ensemble_tpu.serving.registry import ModelRegistry
 
 __all__ = [
@@ -38,4 +55,9 @@ __all__ = [
     "load_packed",
     "InferenceEngine",
     "ModelRegistry",
+    "REPLICA_STATES",
+    "FleetDeadlineError",
+    "FleetOverloadError",
+    "FleetResponse",
+    "FleetRouter",
 ]

@@ -95,6 +95,12 @@ class InferenceEngine:
     max_delay_ms:
         Micro-batching window: how long the queue worker waits to coalesce
         more requests once one is pending.
+    prefix_tiers:
+        Ensemble-prefix member counts to AOT-compile as degraded tiers
+        (see :meth:`PackedModel.take`): ``predict(..., tier=k)`` serves the
+        first-k-member prefix through its own pre-warmed programs, so a
+        fleet under deadline pressure can shed compute without shedding
+        requests — and without a single mid-serve compile.
     donate:
         Donate the padded request buffer to the compiled program; default
         on for backends with real donation support (not CPU).
@@ -115,6 +121,7 @@ class InferenceEngine:
         warm: bool = True,
         label: str = "engine",
         telemetry_path: Optional[str] = None,
+        prefix_tiers: Tuple[int, ...] = (),
     ):
         self._packed = model if isinstance(model, PackedModel) else pack(model)
         if self._packed.num_features <= 0:
@@ -145,6 +152,21 @@ class InferenceEngine:
         self._arrays_struct = jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), self._arrays
         )
+        # degraded tiers: each prefix is its own packed model with its own
+        # (smaller) arrays; bit-identity to a k-round fit is PackedModel
+        # .take()'s contract, the engine just pre-warms the programs
+        self._prefix_tiers = tuple(sorted({int(k) for k in prefix_tiers}))
+        self._tier_nodes: Dict[int, Dict[str, Any]] = {}
+        self._tier_arrays: Dict[int, Dict[str, jax.Array]] = {}
+        self._tier_structs: Dict[int, Any] = {}
+        for k in self._prefix_tiers:
+            sliced = self._packed.take(k)
+            self._tier_nodes[k] = sliced.node
+            self._tier_arrays[k] = sliced.device_arrays()
+            self._tier_structs[k] = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                self._tier_arrays[k],
+            )
         self._metrics = global_metrics()
         self._queue: "queue_mod.SimpleQueue" = queue_mod.SimpleQueue()
         self._worker: Optional[threading.Thread] = None
@@ -160,19 +182,64 @@ class InferenceEngine:
     def buckets(self) -> Tuple[int, ...]:
         return self._buckets
 
+    @property
+    def prefix_tiers(self) -> Tuple[int, ...]:
+        return self._prefix_tiers
+
+    def clone(self, label: str) -> "InferenceEngine":
+        """A fleet replica over the SAME compiled programs and device
+        arrays: its own queue, worker thread, and telemetry stream, but the
+        ``_compiled`` map is shared, so N replicas warm once — fleet warmup
+        cost is O(methods x buckets x tiers), not x N.  (``setdefault``
+        under the GIL keeps the rare concurrent-compile race benign.)"""
+        eng = InferenceEngine.__new__(InferenceEngine)
+        eng._packed = self._packed
+        eng._methods = self._methods
+        eng._buckets = self._buckets
+        eng._max_batch = self._max_batch
+        eng._max_delay_s = self._max_delay_s
+        eng._donate = self._donate
+        eng._label = label
+        eng._telemetry_path = self._telemetry_path
+        eng._stream = serving_stream_id(label)
+        eng._lock = threading.Lock()
+        eng._compiled = self._compiled
+        eng._compile_s = self._compile_s
+        eng._arrays = self._arrays
+        eng._arrays_struct = self._arrays_struct
+        eng._prefix_tiers = self._prefix_tiers
+        eng._tier_nodes = self._tier_nodes
+        eng._tier_arrays = self._tier_arrays
+        eng._tier_structs = self._tier_structs
+        eng._metrics = self._metrics
+        eng._queue = queue_mod.SimpleQueue()
+        eng._worker = None
+        eng._stopped = False
+        eng._warm_snapshot = compile_snapshot()
+        return eng
+
     def bucket_for(self, n: int) -> int:
         for b in self._buckets:
             if n <= b:
                 return b
         return self._max_batch
 
-    def _compile(self, method: str, bucket: int):
-        key = (method, bucket)
+    def _tier_key(self, method: str, bucket: int, tier: int):
+        # full-model programs keep the historical (method, bucket) key so
+        # stats()/contract baselines stay stable; prefix tiers append k
+        return (method, bucket) if not tier else (method, bucket, tier)
+
+    def _arrays_for(self, tier: int):
+        return self._arrays if not tier else self._tier_arrays[tier]
+
+    def _compile(self, method: str, bucket: int, tier: int = 0):
+        key = self._tier_key(method, bucket, tier)
         with self._lock:
             fn = self._compiled.get(key)
         if fn is not None:
             return fn
-        node = self._packed.node
+        node = self._packed.node if not tier else self._tier_nodes[tier]
+        struct = self._arrays_struct if not tier else self._tier_structs[tier]
         d = self._packed.num_features
 
         def run(arrays, X):
@@ -184,7 +251,7 @@ class InferenceEngine:
         jitted = jax.jit(run, donate_argnums=(1,) if self._donate else ())
         t0 = time.perf_counter()
         compiled = jitted.lower(
-            self._arrays_struct,
+            struct,
             jax.ShapeDtypeStruct((bucket, d), jnp.float32),
         ).compile()
         compile_s = time.perf_counter() - t0
@@ -199,6 +266,7 @@ class InferenceEngine:
                 fit_id=self._stream,
                 method=method,
                 bucket=int(bucket),
+                tier=int(tier),
                 compile_s=compile_s,
             )
         return won
@@ -213,11 +281,12 @@ class InferenceEngine:
         d = self._packed.num_features
         for method in methods or self._methods:
             for b in self._buckets:
-                compiled = self._compile(method, b)
-                out = compiled(
-                    self._arrays, jnp.zeros((b, d), jnp.float32)
-                )
-                block_on_arrays(out)
+                for tier in (0,) + self._prefix_tiers:
+                    compiled = self._compile(method, b, tier)
+                    out = compiled(
+                        self._arrays_for(tier), jnp.zeros((b, d), jnp.float32)
+                    )
+                    block_on_arrays(out)
         self._warm_snapshot = compile_snapshot()
         return self
 
@@ -235,7 +304,7 @@ class InferenceEngine:
             )
         return Xa, single
 
-    def _run_padded(self, method: str, Xa: np.ndarray) -> np.ndarray:
+    def _run_padded(self, method: str, Xa: np.ndarray, tier: int = 0):
         """One compiled-program execution: host-side zero-pad to the bucket,
         run, fetch, slice the real rows back out in numpy.  Nothing here
         compiles on a warmed engine — pad AND slice stay on the host (even
@@ -243,37 +312,45 @@ class InferenceEngine:
         which is what makes steady-state serving literally zero-compile."""
         n = Xa.shape[0]
         b = self.bucket_for(n)
-        compiled = self._compiled.get((method, b)) or self._compile(method, b)
+        key = self._tier_key(method, b, tier)
+        compiled = self._compiled.get(key) or self._compile(method, b, tier)
         if n < b:
             buf = np.zeros((b, Xa.shape[1]), np.float32)
             buf[:n] = Xa
             Xa = buf
-        out = compiled(self._arrays, jnp.asarray(Xa))
+        out = compiled(self._arrays_for(tier), jnp.asarray(Xa))
         return np.asarray(out)[:n], b
 
-    def _serve_rows(self, method: str, Xa: np.ndarray):
+    def _serve_rows(self, method: str, Xa: np.ndarray, tier: int = 0):
         """Serve up to any row count: top-bucket chunks + one padded tail.
         Returns host arrays — the serving boundary hands results back to
         network/callers, so the device->host fetch happens exactly once."""
         n = Xa.shape[0]
         if n <= self._max_batch:
-            return self._run_padded(method, Xa)
+            return self._run_padded(method, Xa, tier)
         outs = []
         for i in range(0, n, self._max_batch):
-            out, _ = self._run_padded(method, Xa[i : i + self._max_batch])
+            out, _ = self._run_padded(method, Xa[i : i + self._max_batch], tier)
             outs.append(out)
         return np.concatenate(outs, axis=0), self._max_batch
 
-    def _check_method(self, method: str):
+    def _check_method(self, method: str, tier: int = 0):
         if method not in self._methods:
             raise ValueError(
                 f"engine was not configured to serve {method!r} "
                 f"(methods={self._methods}); construct with "
                 f"methods=(..., {method!r}) so it AOT-warms"
             )
+        if tier and tier not in self._prefix_tiers:
+            raise ValueError(
+                f"engine has no prefix tier {tier} "
+                f"(prefix_tiers={self._prefix_tiers}); construct with "
+                f"prefix_tiers=(..., {tier}) so it AOT-warms"
+            )
 
     def _record(self, method: str, rows: int, bucket: int, latency_s: float,
-                queue_depth: int, batch_rows: int, source: str) -> None:
+                queue_depth: int, batch_rows: int, source: str,
+                tier: int = 0) -> None:
         util = batch_rows / bucket if bucket else 0.0
         emit_event(
             "request_served",
@@ -287,6 +364,7 @@ class InferenceEngine:
             latency_ms=latency_s * 1e3,
             queue_depth=int(queue_depth),
             source=source,
+            tier=int(tier),
         )
         self._metrics.counter("serving/requests").inc()
         self._metrics.counter("serving/rows").inc(int(rows))
@@ -294,17 +372,18 @@ class InferenceEngine:
         self._metrics.histogram("serving/bucket_utilization").record(util)
         self._metrics.gauge("serving/queue_depth").set(queue_depth)
 
-    def predict(self, X, method: str = "predict") -> np.ndarray:
+    def predict(self, X, method: str = "predict", tier: int = 0) -> np.ndarray:
         """Synchronous bucketed inference -> host array; the result is
         materialized before the latency is recorded, so
-        ``request_served.latency_ms`` is honest under async dispatch."""
-        self._check_method(method)
+        ``request_served.latency_ms`` is honest under async dispatch.
+        ``tier=k`` serves through the pre-warmed first-k-member prefix."""
+        self._check_method(method, tier)
         t0 = time.perf_counter()
         Xa, single = self._normalize(X)
-        out, bucket = self._serve_rows(method, Xa)
+        out, bucket = self._serve_rows(method, Xa, tier)
         self._record(
             method, Xa.shape[0], bucket, time.perf_counter() - t0,
-            queue_depth=0, batch_rows=Xa.shape[0], source="sync",
+            queue_depth=0, batch_rows=Xa.shape[0], source="sync", tier=tier,
         )
         return out[0] if single else out
 
@@ -316,19 +395,19 @@ class InferenceEngine:
 
     # -- micro-batching queue ---------------------------------------------
 
-    def submit(self, X, method: str = "predict") -> Future:
+    def submit(self, X, method: str = "predict", tier: int = 0) -> Future:
         """Queue a request; a background worker coalesces pending requests
         into one device dispatch (up to ``max_batch_size`` rows or
         ``max_delay_ms`` of waiting) and resolves each caller's Future with
-        its own rows."""
-        self._check_method(method)
+        its own rows.  Requests only coalesce within a (method, tier)."""
+        self._check_method(method, tier)
         if self._stopped:
             raise RuntimeError("engine is stopped")
         Xa, single = self._normalize(X)
         fut: Future = Future()
         req = _Request(Xa, Xa.shape[0], single, fut, time.perf_counter())
         self._ensure_worker()
-        self._queue.put((method, req))
+        self._queue.put(((method, tier), req))
         return fut
 
     def _ensure_worker(self) -> None:
@@ -351,7 +430,7 @@ class InferenceEngine:
                 continue
             if item is _SHUTDOWN:
                 return
-            method, first = item
+            key, first = item
             batch = [first]
             rows = first.n
             deadline = time.perf_counter() + self._max_delay_s
@@ -364,20 +443,21 @@ class InferenceEngine:
                 except queue_mod.Empty:
                     break
                 if item is _SHUTDOWN:
-                    self._serve_batch(method, batch)
+                    self._serve_batch(key, batch)
                     return
-                nxt_method, req = item
-                if nxt_method != method:
-                    # method switch flushes the current coalesced batch
-                    self._serve_batch(method, batch)
-                    method, batch, rows = nxt_method, [req], req.n
+                nxt_key, req = item
+                if nxt_key != key:
+                    # (method, tier) switch flushes the coalesced batch
+                    self._serve_batch(key, batch)
+                    key, batch, rows = nxt_key, [req], req.n
                     deadline = time.perf_counter() + self._max_delay_s
                     continue
                 batch.append(req)
                 rows += req.n
-            self._serve_batch(method, batch)
+            self._serve_batch(key, batch)
 
-    def _serve_batch(self, method: str, batch: List[_Request]) -> None:
+    def _serve_batch(self, key: Tuple[str, int], batch: List[_Request]) -> None:
+        method, tier = key
         try:
             depth = len(batch)
             Xa = (
@@ -385,7 +465,7 @@ class InferenceEngine:
                 if depth == 1
                 else np.concatenate([r.X for r in batch], axis=0)
             )
-            out, bucket = self._serve_rows(method, Xa)
+            out, bucket = self._serve_rows(method, Xa, tier)
             now = time.perf_counter()
             offset = 0
             for r in batch:
@@ -394,6 +474,7 @@ class InferenceEngine:
                 self._record(
                     method, r.n, bucket, now - r.t_submit,
                     queue_depth=depth, batch_rows=Xa.shape[0], source="queue",
+                    tier=tier,
                 )
                 r.future.set_result(part[0] if r.single else part)
         except Exception as e:  # resolve every caller, never hang a Future
@@ -409,7 +490,11 @@ class InferenceEngine:
         worker = self._worker
         if worker is not None and worker.is_alive():
             self._queue.put(_SHUTDOWN)
-            worker.join(timeout=5.0)
+            # a deferred registry offload can land on the worker itself
+            # (future done-callbacks run on the resolving thread) — the
+            # pill above still drains it, just don't self-join
+            if worker is not threading.current_thread():
+                worker.join(timeout=5.0)
 
     def __enter__(self) -> "InferenceEngine":
         return self
@@ -424,12 +509,14 @@ class InferenceEngine:
         c, s = compile_snapshot()
         with self._lock:
             compiled = {
-                f"{m}@{b}": self._compile_s.get((m, b))
-                for (m, b) in sorted(self._compiled)
+                (f"{k[0]}@{k[1]}" if len(k) == 2 else f"{k[0]}@{k[1]}~{k[2]}"):
+                    self._compile_s.get(k)
+                for k in sorted(self._compiled)
             }
         return {
             "buckets": self._buckets,
             "methods": self._methods,
+            "prefix_tiers": self._prefix_tiers,
             "donate": self._donate,
             "compiled": compiled,
             "compiles_since_warmup": c - self._warm_snapshot[0],

@@ -235,6 +235,13 @@ class PackedModel:
     def is_classifier(self) -> bool:
         return self.num_classes is not None
 
+    @property
+    def num_members(self) -> Optional[int]:
+        """Ensemble size (GBM rounds / boosting members) when the packed
+        family records one; ``None`` for non-ensemble models."""
+        m = self._node.get("extra", {}).get("num_members")
+        return None if m is None else int(m)
+
     # -- arrays ------------------------------------------------------------
 
     @property
@@ -295,6 +302,31 @@ class PackedModel:
 
     def predict_raw(self, X) -> jax.Array:
         return self.model().predict_raw(X)
+
+    # -- ensemble-prefix slicing -------------------------------------------
+
+    def take(self, k: int) -> "PackedModel":
+        """Pack the first-``k``-member prefix of this ensemble.
+
+        Stagewise families (GBM, boosting) expose ``model.take(k)`` whose
+        prediction is bit-identical to fitting the same config for only k
+        rounds — round keys and masks derive from absolute round indices, so
+        the prefix IS the k-round fit.  The sliced arrays are repacked into a
+        fresh :class:`PackedModel`, which is what the serving engine compiles
+        as a degraded tier.  Raises ``TypeError`` for families with no
+        stagewise prefix structure (bagging, stacking, single models)."""
+        model = self.model()
+        if not hasattr(model, "take"):
+            raise TypeError(
+                f"{self.class_name} has no ensemble-prefix structure; "
+                "take(k) applies to GBM and boosting families only"
+            )
+        n = self.num_members
+        if n is not None and not (1 <= int(k) <= n):
+            raise ValueError(
+                f"take(k={k}) out of range for an ensemble of {n} members"
+            )
+        return pack(model.take(int(k)))
 
     # -- persistence -------------------------------------------------------
 

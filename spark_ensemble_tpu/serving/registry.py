@@ -16,6 +16,7 @@ events; per-model request events come from the engines themselves.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from collections import OrderedDict
@@ -33,7 +34,10 @@ __all__ = ["ModelRegistry"]
 
 
 class _Entry:
-    __slots__ = ("packed", "engine", "opts", "hits", "activations", "last_used")
+    __slots__ = (
+        "packed", "engine", "opts", "hits", "activations", "last_used",
+        "pins", "pending_offload",
+    )
 
     def __init__(self, packed: PackedModel, opts: Dict[str, Any]):
         self.packed = packed
@@ -42,6 +46,11 @@ class _Entry:
         self.hits = 0
         self.activations = 0
         self.last_used = 0.0
+        # in-flight requests holding this version's device buffers: LRU
+        # eviction (or explicit evict/rollback) defers while pins > 0, so a
+        # hot-swap can never free arrays out from under an unsent reply
+        self.pins = 0
+        self.pending_offload = False
 
 
 class ModelRegistry:
@@ -136,11 +145,51 @@ class ModelRegistry:
                 self._evict_over_capacity()
             return entry.engine
 
+    def _acquire(self, name: str) -> InferenceEngine:
+        with self._lock:
+            engine = self.engine(name)
+            self._entries[name].pins += 1
+            return engine
+
+    def _release(self, name: str) -> None:
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:  # removed while in flight; nothing to free
+                return
+            entry.pins = max(entry.pins - 1, 0)
+            if entry.pins == 0 and entry.pending_offload:
+                entry.pending_offload = False
+                if entry.engine is not None:
+                    self._offload(name)
+
+    @contextlib.contextmanager
+    def lease(self, name: str):
+        """The warmed engine for ``name``, pinned against eviction for the
+        duration of the ``with`` block: a hot-swap/rollback that evicts
+        this version mid-request defers its offload until the last lease
+        is released (i.e. the reply was sent)."""
+        engine = self._acquire(name)
+        try:
+            yield engine
+        finally:
+            self._release(name)
+
     def predict(self, name: str, X, method: str = "predict"):
-        return self.engine(name).predict(X, method=method)
+        with self.lease(name) as engine:
+            return engine.predict(X, method=method)
 
     def submit(self, name: str, X, method: str = "predict"):
-        return self.engine(name).submit(X, method=method)
+        engine = self._acquire(name)
+        try:
+            fut = engine.submit(X, method=method)
+        except BaseException:
+            self._release(name)
+            raise
+        # the version stays pinned until the reply is delivered — the
+        # done-callback runs after set_result/set_exception, when the
+        # caller's rows are already materialized host-side
+        fut.add_done_callback(lambda _f: self._release(name))
+        return fut
 
     # -- eviction ----------------------------------------------------------
 
@@ -158,6 +207,11 @@ class ModelRegistry:
 
     def _offload(self, name: str) -> None:
         entry = self._entries[name]
+        if entry.pins > 0:
+            # a request resolved against this version and has not replied
+            # yet: defer — _release() completes the offload at pin zero
+            entry.pending_offload = True
+            return
         engine, entry.engine = entry.engine, None
         if engine is not None:
             engine.stop()
@@ -201,6 +255,7 @@ class ModelRegistry:
             return {
                 name: {
                     "resident": e.engine is not None,
+                    "pins": e.pins,
                     "hits": e.hits,
                     "activations": e.activations,
                     "last_used": e.last_used,

@@ -69,6 +69,13 @@ SERVING_EVENT_TYPES = (
     "engine_warmup",
     "request_served",
     "model_evicted",
+    # fleet tier (docs/fleet.md): per-request routing records, breaker
+    # state transitions, hedge firings, staged shedding, periodic SLO rows
+    "fleet_request",
+    "replica_state",
+    "hedge_fired",
+    "request_shed",
+    "fleet_slo",
 )
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,436 @@
+"""Fleet serving tests (docs/fleet.md): ensemble-prefix slicing
+(``PackedModel.take`` PINNED bit-identical to a k-round fit), engine
+prefix tiers (pre-warmed, zero steady-state compiles), replica cloning,
+queue-depth routing, hedged retries and crash drain/replay under
+deterministic chaos, the circuit breaker's half-open re-admission,
+staged degradation + shedding, registry pin-until-reply, and the
+per-replica SLO telemetry events."""
+
+import time
+
+import numpy as np
+import pytest
+
+import spark_ensemble_tpu as se
+from spark_ensemble_tpu.robustness.chaos import ChaosController, install
+from spark_ensemble_tpu.robustness.retry import RetryPolicy
+from spark_ensemble_tpu.serving import (
+    FleetOverloadError,
+    FleetResponse,
+    FleetRouter,
+    InferenceEngine,
+    ModelRegistry,
+    pack,
+)
+from spark_ensemble_tpu.telemetry import record_fits
+from spark_ensemble_tpu.telemetry.events import compile_snapshot
+
+ROUNDS = 5
+
+# the engine-serving numeric contract (see tests/test_serving.py): packed
+# prediction is bit-identical, but the whole-model program fused over a
+# padded batch may move rounding by ~1 ulp
+TOL = dict(rtol=1e-5, atol=1e-6)
+
+
+def _data(n=96, d=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X @ rng.randn(d) + 0.1 * rng.randn(n)).astype(np.float32)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    """One fitted GBM shared across the module (fits dominate runtime;
+    every test only reads it)."""
+    X, y = _data()
+    model = se.GBMRegressor(num_base_learners=ROUNDS, seed=0).fit(X, y)
+    return X, y, model
+
+
+@pytest.fixture(autouse=True)
+def _deterministic_chaos():
+    # pin a never-fires controller: this battery drives the fleet's fault
+    # hooks with its own deterministic controllers, and the exact counter
+    # assertions must hold even under an env-configured chaos tier (the
+    # serving-chaos CI job runs these tests WITH serving faults exported)
+    install(ChaosController(seed=0, rate=0.0))
+    yield
+    install(None)
+
+
+# ---------------------------------------------------------------------------
+# ensemble-prefix export (PackedModel.take)
+# ---------------------------------------------------------------------------
+
+
+def test_take_prefix_bit_identical_to_k_round_fit(fitted):
+    """PINNED: the first k rounds of a packed GBM are bit-identical to a
+    k-round fit — GBM round keys and sampling masks derive from absolute
+    round indices, so round k+1 never perturbs rounds 1..k.  This is the
+    contract that makes prefix degradation exact, not approximate."""
+    X, y, model = fitted
+    p = pack(model)
+    assert p.num_members == ROUNDS
+    for k in (1, 3, ROUNDS):
+        ref = se.GBMRegressor(num_base_learners=k, seed=0).fit(X, y)
+        np.testing.assert_array_equal(
+            np.asarray(p.take(k).predict(X)), np.asarray(ref.predict(X))
+        )
+
+
+def test_take_validates(fitted):
+    X, y, model = fitted
+    p = pack(model)
+    for bad in (0, ROUNDS + 1, -1):
+        with pytest.raises(ValueError, match="out of range"):
+            p.take(bad)
+    bag = pack(se.BaggingRegressor(num_base_learners=2).fit(X, y))
+    with pytest.raises(TypeError, match="prefix"):
+        bag.take(1)
+
+
+# ---------------------------------------------------------------------------
+# engine prefix tiers + cloning
+# ---------------------------------------------------------------------------
+
+
+def test_engine_prefix_tiers_warm_and_exact(fitted):
+    X, y, model = fitted
+    p = pack(model)
+    sizes = (1, 5, 16)
+    # reference predictions BEFORE the compile fence: live-model jits must
+    # not be mistaken for engine steady-state compiles
+    want = {
+        (n, k): np.asarray(p.take(k).predict(X[:n]))
+        for n in sizes
+        for k in (2, 3)
+    }
+    want.update({(n, 0): np.asarray(p.predict(X[:n])) for n in sizes})
+    with record_fits() as rec:
+        with InferenceEngine(
+            p, prefix_tiers=(2, 3), min_bucket=8, max_batch_size=16
+        ) as eng:
+            assert eng.prefix_tiers == (2, 3)
+            assert set(eng.stats()["compiled"]) == {
+                "predict@8", "predict@16",
+                "predict@8~2", "predict@16~2",
+                "predict@8~3", "predict@16~3",
+            }
+            c0, _ = compile_snapshot()
+            for n in sizes:
+                for k in (0, 2, 3):
+                    out = eng.predict(X[:n], tier=k)
+                    np.testing.assert_allclose(
+                        np.asarray(out), want[(n, k)], **TOL
+                    )
+            # the async queue coalesces tiered requests too
+            fut = eng.submit(X[:5], tier=2)
+            np.testing.assert_allclose(
+                np.asarray(fut.result(timeout=30)), want[(5, 2)], **TOL
+            )
+            assert compile_snapshot()[0] == c0  # zero steady-state compiles
+            assert eng.stats()["compiles_since_warmup"] == 0
+            with pytest.raises(ValueError, match="prefix_tiers"):
+                eng.predict(X[:4], tier=4)
+    warm = [e for e in rec.events if e["event"] == "engine_warmup"]
+    assert len(warm) == 6  # 2 buckets x (full + 2 tiers)
+    assert sorted({e["tier"] for e in warm}) == [0, 2, 3]
+
+
+def test_engine_clone_shares_programs(fitted):
+    X, y, model = fitted
+    p = pack(model)
+    want = np.asarray(p.predict(X[:5]))
+    want3 = np.asarray(p.take(3).predict(X[:5]))
+    with InferenceEngine(
+        p, prefix_tiers=(3,), min_bucket=8, max_batch_size=16
+    ) as eng:
+        c0, _ = compile_snapshot()
+        clone = eng.clone("clone")
+        try:
+            np.testing.assert_allclose(
+                np.asarray(clone.predict(X[:5])), want, **TOL
+            )
+            np.testing.assert_allclose(
+                np.asarray(clone.predict(X[:5], tier=3)), want3, **TOL
+            )
+            fut = clone.submit(X[:5])
+            np.testing.assert_allclose(
+                np.asarray(fut.result(timeout=30)), want, **TOL
+            )
+            # cloning compiled NOTHING: programs and arrays are shared
+            assert compile_snapshot()[0] == c0
+            assert clone.stats()["compiles_since_warmup"] == 0
+        finally:
+            clone.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet routing + SLO telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_routes_and_zero_compiles(fitted):
+    X, y, model = fitted
+    sizes = (1, 4, 7, 16)
+    want = {n: np.asarray(model.predict(X[:n])) for n in sizes}
+    with record_fits() as rec:
+        with FleetRouter(
+            model, replicas=3, min_bucket=8, max_batch_size=16,
+            deadline_ms=30_000.0,
+        ) as fleet:
+            for i in range(8):
+                n = sizes[i % len(sizes)]
+                resp = fleet.predict(X[:n])
+                assert isinstance(resp, FleetResponse)
+                assert resp.tier == 0 and not resp.degraded
+                np.testing.assert_allclose(resp.value, want[n], **TOL)
+            # a concurrent burst spreads across replicas (depth routing)
+            futs = [
+                fleet.submit(X[: sizes[i % len(sizes)]]) for i in range(24)
+            ]
+            for i, f in enumerate(futs):
+                r = f.result(timeout=30)
+                np.testing.assert_allclose(
+                    r.value, want[sizes[i % len(sizes)]], **TOL
+                )
+            snap = fleet.slo_snapshot()
+            assert snap["requests"] == 32
+            assert snap["compiles_since_warmup"] == 0
+            assert snap["shed"] == 0 and snap["crashes"] == 0
+            assert sum(
+                r["served"] for r in snap["replicas"].values()
+            ) >= 32
+            busy = [
+                r for r in snap["replicas"].values() if r["served"] > 0
+            ]
+            assert len(busy) >= 2  # the burst did not pile on one replica
+            assert snap["p99_ms"] >= snap["p50_ms"] > 0
+            assert fleet.stats()["fleet"]["requests"] == 32
+    served = [e for e in rec.events if e["event"] == "fleet_request"]
+    assert len(served) == 32
+    assert all(e["latency_ms"] > 0 and not e["degraded"] for e in served)
+    slo = [e for e in rec.events if e["event"] == "fleet_slo"]
+    # stop() emits one row per replica plus the aggregate "*" row
+    assert {e["replica"] for e in slo} >= {"*"}
+    assert len(slo) == 4
+
+
+# ---------------------------------------------------------------------------
+# chaos battery: stall -> hedge, crash -> drain/replay, half-open probe
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_hedges_on_stalled_replica(fitted):
+    X, y, model = fitted
+    want = np.asarray(model.predict(X[:4]))
+    install(ChaosController(seed=7, rate=1.0, faults=("replica_stall",)))
+    with FleetRouter(
+        model, replicas=2, min_bucket=8, max_batch_size=16,
+        deadline_ms=30_000.0, hedge_init_ms=10.0,
+    ) as fleet:
+        resp = fleet.predict(X[:4])
+        np.testing.assert_allclose(resp.value, want, **TOL)
+        assert resp.hedged
+        snap = fleet.slo_snapshot()
+        assert snap["hedges_fired"] >= 1
+        assert snap["crashes"] == 0  # stall is hedge territory, not breaker
+
+
+def test_fleet_kill_replica_drains_and_replays(fitted):
+    """The acceptance scenario: one replica killed under load -> every
+    in-flight and queued request still resolves exactly once with the
+    right value (zero lost, zero duplicated)."""
+    X, y, model = fitted
+    want = np.asarray(model.predict(X[:4]))
+    with FleetRouter(
+        model, replicas=2, min_bucket=8, max_batch_size=16,
+        deadline_ms=30_000.0, shed_depth=10_000,
+    ) as fleet:
+        futs = [fleet.submit(X[:4]) for _ in range(40)]
+        killed = fleet.kill_replica()
+        # the kill pill sits mid-queue: later submits still route to the
+        # dying replica and must be drained onto the survivor
+        futs += [fleet.submit(X[:4]) for _ in range(20)]
+        responses = [f.result(timeout=60) for f in futs]
+        assert len(responses) == 60  # zero lost; Futures resolve once
+        for r in responses:
+            np.testing.assert_allclose(r.value, want, **TOL)
+        snap = fleet.slo_snapshot()
+        assert snap["crashes"] == 1
+        assert snap["replays"] >= 1
+        assert snap["replicas"][killed]["state"] == "ejected"
+        live = [
+            r for r in snap["replicas"].values() if r["state"] != "ejected"
+        ]
+        assert len(live) == 1 and live[0]["state"] in ("healthy", "degraded")
+
+
+def test_fleet_chaos_crash_then_half_open_readmission(fitted):
+    X, y, model = fitted
+    want = np.asarray(model.predict(X[:4]))
+    install(ChaosController(seed=3, rate=1.0, faults=("replica_crash",)))
+    backoff = RetryPolicy(
+        max_retries=0, base_delay=0.05, max_delay=0.1, jitter=0.0
+    )
+    with FleetRouter(
+        model, replicas=2, min_bucket=8, max_batch_size=16,
+        deadline_ms=30_000.0, breaker_backoff=backoff,
+    ) as fleet:
+        # the first serve draws the (budget-1) chaos crash; the request is
+        # replayed on the survivor and still succeeds
+        resp = fleet.predict(X[:4])
+        np.testing.assert_allclose(resp.value, want, **TOL)
+        assert resp.replays >= 1
+        snap = fleet.slo_snapshot()
+        assert snap["crashes"] == 1
+        ejected = [
+            n for n, r in snap["replicas"].items()
+            if r["state"] == "ejected"
+        ]
+        assert len(ejected) == 1
+        time.sleep(0.2)  # past the breaker backoff -> half-open
+        for _ in range(8):
+            np.testing.assert_allclose(
+                fleet.predict(X[:4]).value, want, **TOL
+            )
+        snap = fleet.slo_snapshot()
+        # the probe request re-admitted the crashed replica
+        assert all(
+            r["state"] == "healthy" for r in snap["replicas"].values()
+        )
+        assert all(r["served"] > 0 for r in snap["replicas"].values())
+        assert snap["requests"] == 9 and snap["crashes"] == 1
+
+
+# ---------------------------------------------------------------------------
+# staged degradation + shedding
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_degrades_to_prefix_under_deadline_pressure(fitted):
+    X, y, model = fitted
+    p = pack(model)
+    want2 = np.asarray(p.take(2).predict(X[:4]))
+    want_full = np.asarray(p.predict(X[:4]))
+    with FleetRouter(
+        model, replicas=2, prefix_tiers=(2,), min_bucket=8,
+        max_batch_size=16, deadline_ms=30_000.0, deadline_grace=1e6,
+    ) as fleet:
+        # a budget far below the latency estimate degrades to the prefix
+        resp = fleet.predict(X[:4], deadline_ms=0.25)
+        assert resp.degraded and resp.tier == 2
+        np.testing.assert_allclose(resp.value, want2, **TOL)
+        # a relaxed budget serves the full model again
+        full = fleet.predict(X[:4])
+        assert not full.degraded and full.tier == 0
+        np.testing.assert_allclose(full.value, want_full, **TOL)
+        snap = fleet.slo_snapshot()
+        assert snap["degraded"] == 1
+        assert 0.0 < snap["degraded_share"] < 1.0
+        assert snap["compiles_since_warmup"] == 0  # tiers were pre-warmed
+
+
+def test_fleet_sheds_past_depth_and_without_live_replicas(fitted):
+    X, y, model = fitted
+    with FleetRouter(
+        model, replicas=1, min_bucket=8, max_batch_size=16, shed_depth=0
+    ) as fleet:
+        with pytest.raises(FleetOverloadError, match="shed"):
+            fleet.submit(X[:4])
+        assert fleet.slo_snapshot()["shed"] == 1
+    slow = RetryPolicy(max_retries=0, base_delay=60.0, max_delay=60.0)
+    with FleetRouter(
+        model, replicas=1, min_bucket=8, max_batch_size=16,
+        deadline_ms=30_000.0, breaker_backoff=slow,
+    ) as fleet:
+        fleet.predict(X[:4])
+        killed = fleet.kill_replica()
+        deadline = time.time() + 10.0
+        while (
+            fleet.slo_snapshot()["replicas"][killed]["state"] != "ejected"
+            and time.time() < deadline
+        ):
+            time.sleep(0.01)
+        with pytest.raises(FleetOverloadError, match="no live replica"):
+            fleet.submit(X[:4])
+
+
+def test_fleet_rejects_malformed_requests_without_breaker_damage(fitted):
+    X, y, model = fitted
+    with FleetRouter(
+        model, replicas=2, min_bucket=8, max_batch_size=16,
+        deadline_ms=30_000.0,
+    ) as fleet:
+        with pytest.raises(ValueError):
+            fleet.submit(np.zeros((4, 3), np.float32))  # wrong n_features
+        snap = fleet.slo_snapshot()
+        # a caller error is not a replica fault: no breaker movement
+        assert all(
+            r["state"] == "healthy" and r["failed"] == 0
+            for r in snap["replicas"].values()
+        )
+
+
+# ---------------------------------------------------------------------------
+# registry integration (pin-until-reply)
+# ---------------------------------------------------------------------------
+
+
+def test_registry_pin_defers_eviction_until_release(fitted):
+    X, y, model = fitted
+    other = se.GBMRegressor(num_base_learners=2, seed=1).fit(X, y)
+    with ModelRegistry(capacity=1, min_bucket=8, max_batch_size=16) as reg:
+        reg.register("g", model)
+        reg.register("h", other)
+        want = np.asarray(reg.predict("g", X[:4]))
+        with reg.lease("g") as eng:
+            reg.engine("h")  # over capacity: would evict g, but it's pinned
+            st = reg.stats()["g"]
+            assert st["resident"] and st["pins"] == 1
+            np.testing.assert_array_equal(
+                np.asarray(eng.predict(X[:4])), want
+            )
+        # the deferred offload lands the moment the last lease releases
+        st = reg.stats()["g"]
+        assert st["pins"] == 0 and not st["resident"]
+
+        # same race through the async path: an in-flight submit pins its
+        # version; the reply is served from the buffers eviction targeted
+        reg.engine("g")  # reactivate (evicts h)
+        fut = reg.submit("g", X[:4])
+        reg.engine("h")  # races the queued request
+        np.testing.assert_array_equal(
+            np.asarray(fut.result(timeout=30)), want
+        )
+        deadline = time.time() + 10.0
+        while reg.stats()["g"]["pins"] > 0 and time.time() < deadline:
+            time.sleep(0.005)
+        st = reg.stats()["g"]
+        assert st["pins"] == 0 and not st["resident"]
+
+
+def test_fleet_from_registry_pins_until_stop(fitted):
+    X, y, model = fitted
+    other = se.GBMRegressor(num_base_learners=2, seed=1).fit(X, y)
+    with ModelRegistry(capacity=1, min_bucket=8, max_batch_size=16) as reg:
+        reg.register("g", model)
+        reg.register("h", other)
+        fleet = FleetRouter.from_registry(
+            reg, "g", replicas=2, deadline_ms=30_000.0
+        )
+        try:
+            want = fleet.predict(X[:4]).value
+            reg.engine("h")  # hot-swap pressure: g stays pinned under the fleet
+            st = reg.stats()["g"]
+            assert st["resident"] and st["pins"] == 1
+            resp = fleet.predict(X[:4])
+            np.testing.assert_array_equal(
+                np.asarray(resp.value), np.asarray(want)
+            )
+        finally:
+            fleet.stop()
+        st = reg.stats()["g"]
+        assert st["pins"] == 0 and not st["resident"]

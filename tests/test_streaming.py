@@ -13,6 +13,7 @@ import jax
 import spark_ensemble_tpu as se
 from spark_ensemble_tpu.autotune.resolve import override
 from spark_ensemble_tpu.data import (
+    ShardLoadError,
     ShardPrefetcher,
     ShardStore,
     write_shards,
@@ -184,6 +185,38 @@ def test_prefetcher_abandoned_sweep_recovers(tmp_path):
         gen.close()  # mid-round death (chaos preemption unwinding)
         # the next sweep reconciles against whatever is still in flight
         assert [s for s, _ in pf.sweep()] == [0, 1, 2]
+
+
+def test_prefetcher_attributes_worker_errors(tmp_path):
+    """A worker-thread read failure surfaces on the consumer as a
+    ShardLoadError naming the shard that broke (not just whichever await
+    lost), and lands in take_stats() for the per-round telemetry."""
+    X, _ = _data()
+    store = _store(tmp_path, X, shard_rows=64)
+
+    class _FlakyStore:
+        num_shards = store.num_shards
+        n = store.n
+
+        @staticmethod
+        def load_shard(s):
+            if s == 1:
+                raise IOError("disk went away")
+            return store.load_shard(s)
+
+    with ShardPrefetcher(_FlakyStore(), depth=2, to_device=False) as pf:
+        gen = pf.sweep()
+        s0, _arr = next(gen)
+        assert s0 == 0
+        with pytest.raises(ShardLoadError, match="shard 1") as ei:
+            for _ in gen:  # pragma: no branch - raises on the next shard
+                pass
+        assert ei.value.shard == 1
+        assert isinstance(ei.value.__cause__, IOError)
+        st = pf.take_stats()
+        assert st["errors"] == 1
+        assert "shard 1" in st["last_error"]
+        assert st["loads"] == 1  # only shard 0 landed
 
 
 # ---------------------------------------------------------------------------

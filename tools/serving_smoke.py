@@ -16,6 +16,18 @@ artifact round-trips across a process restart:
         micro-batching queue) asserting tight allclose and zero compiles
         after warmup.  Serving telemetry events go to PATH (JSONL).
 
+A third subcommand drives the resilient fleet (CI `serving-chaos` job;
+docs/fleet.md) under whatever chaos controller the environment
+configures (SE_TPU_CHAOS + serving faults):
+
+    python tools/serving_smoke.py fleet --out DIR [--telemetry PATH]
+        Load the artifact, put a FleetRouter over it (prefix tier
+        pre-warmed), run a multi-threaded closed loop that kills one
+        replica mid-stream ON TOP of any env-injected faults, and assert
+        ZERO failed requests, zero steady-state compiles, and exact
+        ensemble-prefix degradation.  The per-replica SLO rows land in
+        the --telemetry JSONL.
+
 Exit code 0 = every assertion held; any mismatch raises.
 """
 
@@ -134,6 +146,91 @@ def cmd_serve(args):
     }))
 
 
+def cmd_fleet(args):
+    import threading
+
+    from spark_ensemble_tpu.serving import FleetRouter, load_packed
+
+    expected = np.load(os.path.join(args.out, "expected.npz"))
+    X = expected["X"]
+    packed = load_packed(os.path.join(args.out, "model"))
+    tier = max(1, packed.num_members // 2)
+    # prefix exactness, pinned BEFORE the fleet warms: the degraded tier
+    # IS a k-round model (PackedModel.take), not an approximation
+    # graftlint: ignore[unfenced-blocking-read] -- one-off expectation readback before any serving path is live
+    tier_pred = np.asarray(packed.take(tier).predict(X))
+    full_pred = expected["predict"]
+
+    n_req, n_threads = int(args.requests), 4
+    failed = [0]
+    router = FleetRouter(
+        packed,
+        replicas=int(args.replicas),
+        prefix_tiers=(tier,),
+        max_batch_size=256,
+        deadline_ms=10_000.0,
+        # the starvation probe below waits synchronously on a 0.25 ms
+        # budget; a generous grace keeps the wait from outrunning the reply
+        deadline_grace=40_000.0,
+        telemetry_path=args.telemetry,
+        label="smoke-fleet",
+    )
+
+    def worker(tid):
+        rng = np.random.RandomState(tid)
+        for i in range(tid, n_req, n_threads):
+            if tid == 0 and i == (n_req // 2 // n_threads) * n_threads:
+                # a deterministic kill ON TOP of whatever the env-chaos
+                # controller injects: the acceptance scenario is a replica
+                # dying mid-load with zero lost requests
+                router.kill_replica()
+            n = int(rng.randint(1, 64))
+            try:
+                resp = router.predict(X[:n], deadline_ms=10_000.0)
+            except Exception:  # noqa: BLE001 - counted; zero is the bar
+                failed[0] += 1
+                continue
+            want = tier_pred if resp.degraded else full_pred
+            assert np.allclose(resp.value, want[:n], rtol=1e-5, atol=1e-6)
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+
+    # a starvation budget forces the degradation path even on an idle
+    # runner: the response must carry the explicit flag AND the exact
+    # prefix prediction
+    resp = router.predict(X[:16], deadline_ms=0.25)
+    assert resp.degraded and resp.tier == tier
+    assert np.allclose(resp.value, tier_pred[:16], rtol=1e-5, atol=1e-6)
+
+    snap = router.slo_snapshot()
+    router.stop()  # emits the fleet_slo rows to --telemetry
+    assert failed[0] == 0, f"{failed[0]} requests failed under faults"
+    assert snap["compiles_since_warmup"] == 0, snap
+    assert snap["crashes"] >= 1  # the deterministic kill, at minimum
+    print(json.dumps({
+        "requests": snap["requests"],
+        "failed": failed[0],
+        "crashes": snap["crashes"],
+        "replays": snap["replays"],
+        "hedges_fired": snap["hedges_fired"],
+        "degraded_share": snap["degraded_share"],
+        "p50_ms": snap["p50_ms"],
+        "p99_ms": snap["p99_ms"],
+        "compiles_since_warmup": snap["compiles_since_warmup"],
+        "replica_states": {
+            name: rep["state"] for name, rep in snap["replicas"].items()
+        },
+        "pid": os.getpid(),
+        "telemetry": args.telemetry,
+    }))
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -150,6 +247,12 @@ def main(argv=None):
         "(persistent-cache disk hits emit no backend_compile events)",
     )
     p_serve.set_defaults(fn=cmd_serve)
+    p_fleet = sub.add_parser("fleet")
+    p_fleet.add_argument("--out", required=True)
+    p_fleet.add_argument("--telemetry", default=None)
+    p_fleet.add_argument("--replicas", type=int, default=3)
+    p_fleet.add_argument("--requests", type=int, default=200)
+    p_fleet.set_defaults(fn=cmd_fleet)
     args = parser.parse_args(argv)
     os.makedirs(args.out, exist_ok=True)
     args.fn(args)

@@ -166,6 +166,37 @@ def shard_io_line(fit_events: List[dict]) -> Optional[str]:
     return "  ".join(parts)
 
 
+def fleet_slo_line(fit_events: List[dict]) -> Optional[str]:
+    """Fleet SLO summary (serving/fleet.py): the aggregate ``fleet_slo``
+    row's request latency percentiles plus the resilience counters —
+    hedges fired/won, replays, crashes absorbed, degraded share."""
+    agg = next(
+        (
+            e
+            for e in reversed(fit_events)
+            if e.get("event") == "fleet_slo" and e.get("replica") == "*"
+        ),
+        None,
+    )
+    if agg is None:
+        return None
+    parts = [
+        f"fleet SLO: {int(agg.get('requests', 0))} requests  "
+        f"p50 {float(agg.get('p50_ms', 0.0)):.2f}ms  "
+        f"p99 {float(agg.get('p99_ms', 0.0)):.2f}ms"
+    ]
+    hedges = int(agg.get("hedges_fired", 0))
+    if hedges:
+        parts.append(f"hedges {hedges} ({int(agg.get('hedges_won', 0))} won)")
+    for k in ("replays", "crashes", "shed"):
+        if int(agg.get(k, 0)):
+            parts.append(f"{k} {int(agg[k])}")
+    share = float(agg.get("degraded_share", 0.0))
+    if share:
+        parts.append(f"degraded {100.0 * share:.1f}%")
+    return "  ".join(parts)
+
+
 def render_fit(fit_id: str, fit_events: List[dict]) -> str:
     lines = [f"== {fit_id} =="]
     start = next(
@@ -223,6 +254,9 @@ def render_fit(fit_id: str, fit_events: List[dict]) -> str:
     shard_io = shard_io_line(fit_events)
     if shard_io:
         lines.append(shard_io)
+    fleet = fleet_slo_line(fit_events)
+    if fleet:
+        lines.append(fleet)
     probe = next(
         (e for e in fit_events if e.get("event") == "phase_probe"), None
     )
