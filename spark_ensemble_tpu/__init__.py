@@ -94,8 +94,12 @@ from spark_ensemble_tpu import telemetry
 from spark_ensemble_tpu.telemetry import (
     FitTelemetry,
     MetricsRegistry,
+    Span,
     TelemetryRecorder,
+    TraceContext,
+    Tracer,
     record_fits,
+    trace_annotations_enabled,
 )
 from spark_ensemble_tpu import robustness
 from spark_ensemble_tpu.robustness import (
@@ -205,6 +209,10 @@ __all__ = [
     "MetricsRegistry",
     "TelemetryRecorder",
     "record_fits",
+    "Span",
+    "TraceContext",
+    "Tracer",
+    "trace_annotations_enabled",
     "ChaosController",
     "ChaosPreemption",
     "ChaosTransientError",

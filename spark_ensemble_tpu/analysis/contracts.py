@@ -626,6 +626,42 @@ def _trace_streaming(report: ContractReport) -> None:
             )
 
 
+def _trace_tracing(report: ContractReport) -> None:
+    """Trace the causal-tracing plane's own budget (telemetry/trace.py).
+
+    Spans are a pure host-side construct: beginning, nesting, ending and
+    reconstructing them must dispatch ZERO cached device programs — the
+    pin that lets tracing stay enabled in production fits without
+    touching any compile budget.  Also sanity-checks the span records
+    themselves (one per unit of work, all on one trace)."""
+    from spark_ensemble_tpu.models.base import observe_program_calls
+    from spark_ensemble_tpu.telemetry.trace import Tracer
+
+    sink: List[Dict[str, Any]] = []
+    tracer = Tracer(sink.append, thread="contract")
+    rec = _ProgramRecorder()
+    with observe_program_calls(rec):
+        with tracer.begin_span("fit", family="contract") as root:
+            with tracer.begin_span("round_chunk", parent=root, chunk_seq=0):
+                pass
+            tracer.emit_span(
+                "shard_load", 0.0, 1e-3, parent=root.context(),
+                thread="se-tpu-shard",
+            )
+    report.budgets["tracing.spans"] = rec.count()
+    if len(sink) != 3 or any(
+        s["trace_id"] != tracer.trace_id for s in sink
+    ):
+        report.violations.append(
+            ContractViolation(
+                "tracing",
+                "tracing.spans",
+                f"expected 3 span records on trace {tracer.trace_id}, got "
+                f"{[s.get('name') for s in sink]}",
+            )
+        )
+
+
 def trace_contracts(
     entry_points: Optional[List[str]] = None,
 ) -> ContractReport:
@@ -648,6 +684,8 @@ def trace_contracts(
             _trace_fleet(report)
         if wanted is None or "streaming" in wanted:
             _trace_streaming(report)
+        if wanted is None or "tracing" in wanted:
+            _trace_tracing(report)
     return report
 
 

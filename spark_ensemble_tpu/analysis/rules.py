@@ -973,3 +973,116 @@ class HostCallInJitRule(LintRule):
                         "baked-in constant); hoist it out of the traced "
                         "scope",
                     )
+
+
+# ---------------------------------------------------------------------------
+# rule: unclosed-span
+# ---------------------------------------------------------------------------
+
+#: call names that start a telemetry span (telemetry/trace.py)
+_SPAN_STARTERS = ("begin_span", "start_span", "trace_span")
+
+
+@register_rule
+class UnclosedSpanRule(LintRule):
+    id = "unclosed-span"
+    doc = (
+        "A telemetry span is started (`begin_span`/`start_span`/"
+        "`trace_span`) but its end is not syntactically guaranteed: the "
+        "result is discarded as a bare statement, or bound to a local "
+        "name that is neither entered as `with <name>:` nor `.end()`-ed "
+        "inside a `try/finally` in the same function.  A span that can "
+        "skip its `end()` on an exception path never emits — the trace "
+        "silently loses the exact unit of work that failed "
+        "(docs/tracing.md).  Handing the span to another call, returning "
+        "it, storing it in a container/attribute, or using the `with` "
+        "form are all fine — ownership moved somewhere that ends it."
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Attribute):
+                fname = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                fname = node.func.id
+            else:
+                continue
+            if fname not in _SPAN_STARTERS:
+                continue
+            msg = self._classify(ctx, node)
+            if msg is not None:
+                yield self.finding(ctx, node, msg)
+
+    def _classify(self, ctx, call: ast.Call) -> Optional[str]:
+        """None == the span's end is guaranteed (or ownership moved);
+        a message == flag it.  Conservative: only the two provably-leaky
+        shapes (dropped result, local bind with no with/finally end) are
+        flagged."""
+        cur: ast.AST = call
+        parent = ctx.parents.get(cur)
+        while parent is not None:
+            if isinstance(parent, ast.withitem):
+                return None  # `with ...begin_span(...):` — exit guaranteed
+            if isinstance(parent, ast.Call) and cur is not parent.func:
+                return None  # handed to another call (append, ctor, ...)
+            if isinstance(parent, (ast.Return, ast.Yield, ast.YieldFrom)):
+                return None  # ownership transferred to the caller
+            if isinstance(parent, ast.Expr):
+                return (
+                    "span started and immediately discarded: nothing can "
+                    "ever end it (use `with`, or bind it and end in a "
+                    "finally)"
+                )
+            if isinstance(parent, ast.Assign):
+                return self._check_assign(ctx, parent, call)
+            if isinstance(
+                parent,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                 ast.Module),
+            ):
+                return None
+            cur, parent = parent, ctx.parents.get(parent)
+        return None
+
+    def _check_assign(self, ctx, assign: ast.Assign, call) -> Optional[str]:
+        names: List[str] = []
+        for t in assign.targets:
+            if isinstance(t, ast.Name):
+                names.append(t.id)
+            else:
+                # attribute/subscript/tuple target: the span lives in a
+                # structure whose owner is responsible for ending it
+                return None
+        fn = ctx.enclosing_function(call) or ctx.tree
+        for name in names:
+            if self._end_guaranteed(fn, name):
+                return None
+        name = names[0] if names else "?"
+        return (
+            f"span bound to `{name}` with no guaranteed end in this "
+            f"function: enter it (`with {name}:`) or call `{name}.end()` "
+            "inside a try/finally"
+        )
+
+    @staticmethod
+    def _end_guaranteed(fn, name: str) -> bool:
+        for sub in _walk_scope(fn):
+            if isinstance(sub, ast.With):
+                for item in sub.items:
+                    e = item.context_expr
+                    if isinstance(e, ast.Name) and e.id == name:
+                        return True
+            elif isinstance(sub, ast.Try):
+                for stmt in sub.finalbody:
+                    for n in ast.walk(stmt):
+                        if (
+                            isinstance(n, ast.Call)
+                            and isinstance(n.func, ast.Attribute)
+                            and n.func.attr in ("end", "__exit__")
+                            and isinstance(n.func.value, ast.Name)
+                            and n.func.value.id == name
+                        ):
+                            return True
+        return False

@@ -32,10 +32,28 @@ import jax
 import numpy as np
 
 from spark_ensemble_tpu.autotune.resolve import resolve as _tuned
+from spark_ensemble_tpu.telemetry.events import global_metrics
+from spark_ensemble_tpu.telemetry.trace import new_flow_id
 
 #: default lookahead (shards in flight past the one being consumed) —
 #: the "prefetch_depth" tunable's default (autotune/space.py)
 DEFAULT_PREFETCH_DEPTH = 2
+
+
+def _mirror_shard_metrics(hit: bool, nbytes: int, load_s: float,
+                          wait_s: float) -> None:
+    """Mirror one shard's I/O into the process-global registry
+    (``telemetry.global_metrics()``) so ``MetricsRegistry.snapshot()``
+    is a one-stop process view — the per-fit ``take_stats()`` ledger
+    resets on read, these accumulate for the process lifetime."""
+    g = global_metrics()
+    g.counter("data/shard_loads").inc()
+    g.counter("data/shard_bytes").inc(nbytes)
+    g.counter(
+        "data/shard_prefetch_hits" if hit else "data/shard_prefetch_misses"
+    ).inc()
+    g.histogram("data/shard_load_s").record(load_s)
+    g.histogram("data/shard_wait_s").record(wait_s)
 
 
 class ShardLoadError(RuntimeError):
@@ -81,11 +99,15 @@ class ShardPrefetcher:
             "errors": 0, "last_error": None,
         }
 
-    def _read(self, s: int) -> Tuple[np.ndarray, float]:
-        # worker thread: numpy + file IO only (no JAX, no telemetry)
+    def _read(self, s: int) -> Tuple[np.ndarray, float, float]:
+        # worker thread: numpy + file IO only (no JAX, no telemetry).
+        # The wall-clock start rides back so the CONSUMER can reconstruct
+        # the worker's load as a span on the "se-tpu-shard" track without
+        # the worker ever touching telemetry (telemetry/trace.py).
+        wall0 = time.time()
         t0 = time.perf_counter()
         arr = self.store.load_shard(s)
-        return arr, time.perf_counter() - t0
+        return arr, time.perf_counter() - t0, wall0
 
     def _schedule_from(self, pos: int) -> None:
         S = self.store.num_shards
@@ -107,9 +129,10 @@ class ShardPrefetcher:
             if fut is None:  # pragma: no cover - reconcile safety net
                 fut = self._ex.submit(self._read, pos)
             hit = fut.done()
+            wait_wall0 = time.time()
             t0 = time.perf_counter()
             try:
-                arr, load_s = fut.result()
+                arr, load_s, load_wall0 = fut.result()
             except Exception as e:
                 # attribute the abort to the shard that broke: the wait is
                 # still charged, the failure lands in take_stats(), and the
@@ -118,6 +141,7 @@ class ShardPrefetcher:
                 st["wait_s"] += time.perf_counter() - t0
                 st["errors"] += 1
                 st["last_error"] = f"shard {pos}: {type(e).__name__}: {e}"
+                global_metrics().counter("data/shard_errors").inc()
                 raise ShardLoadError(pos, e) from e
             wait_s = time.perf_counter() - t0
             st = self._stats
@@ -126,10 +150,26 @@ class ShardPrefetcher:
             st["load_s"] += load_s
             st["hits" if hit else "misses"] += 1
             st["wait_s"] += wait_s
+            _mirror_shard_metrics(hit, arr.nbytes, load_s, wait_s)
             if self.telem is not None and self.telem.enabled:
                 # the overlap miss the prefetcher exists to hide, charged
                 # to the same host-blocked ledger as device-read fences
                 self.telem.host_blocked(wait_s)
+                # causal spans (docs/tracing.md): the worker's load,
+                # reconstructed from its measured wall window onto the
+                # worker track, and the consumer's wait — with a flow
+                # arrow between them when the wait was CAUSED by the
+                # load still running (a prefetch miss)
+                flow = None if hit else new_flow_id()
+                self.telem.emit_span(
+                    "shard_load", load_wall0, load_s,
+                    thread="se-tpu-shard", shard=pos, bytes=arr.nbytes,
+                    flow_out=None if flow is None else [flow],
+                )
+                self.telem.emit_span(
+                    "shard_wait", wait_wall0, wait_s,
+                    shard=pos, hit=hit, flow_in=flow,
+                )
             # keep the worker busy while the device consumes this shard
             self._schedule_from(pos + 1)
             if self.to_device:

@@ -47,6 +47,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from spark_ensemble_tpu.telemetry.trace import NULL_SPAN, new_flow_id
+
 PIPELINE_ENV = "SE_TPU_PIPELINE"
 DEVICE_PATIENCE_ENV = "SE_TPU_DEVICE_PATIENCE"
 
@@ -195,6 +197,12 @@ class RoundAdapter:
     #: the fully synchronous pre-pipeline path
     depth: int = 0
 
+    #: the fit's FitTelemetry, when the family wires one through — the
+    #: executor traces each chunk's dispatch→commit life as a span with
+    #: its commit/invalidate fate (telemetry/trace.py); None (the
+    #: default, kept by bare test adapters) traces nothing
+    telem = None
+
     def should_continue(self) -> bool:
         raise NotImplementedError
 
@@ -233,18 +241,56 @@ class RoundExecutor:
 
     def run(self) -> RoundAdapter:
         a = self.adapter
+        telem = a.telem
         pending: deque = deque()
-        while a.should_continue():
-            while a.can_launch() and len(pending) < max(1, a.window()):
-                pending.append(a.launch())
-            if not pending:
-                # frontier exhausted with nothing in flight: only an
-                # adapter whose committed state lags its own frontier can
-                # get here, and committing is impossible — stop cleanly
-                break
-            entry = pending.popleft()
-            if a.commit(entry, speculated=bool(pending)):
-                pending.clear()
-                a.reset_frontier()
+        seq = 0
+        try:
+            while a.should_continue():
+                while a.can_launch() and len(pending) < max(1, a.window()):
+                    # span first, then launch: the chunk span covers the
+                    # dispatch and stays open until its commit resolves
+                    # its fate (committed / invalidated / abandoned)
+                    pending.append((
+                        NULL_SPAN if telem is None else telem.begin_span(
+                            "round_chunk", chunk_seq=seq,
+                            speculative=bool(pending),
+                        ),
+                        a.launch(),
+                    ))
+                    seq += 1
+                if not pending:
+                    # frontier exhausted with nothing in flight: only an
+                    # adapter whose committed state lags its own frontier
+                    # can get here, and committing is impossible — stop
+                    break
+                sp, entry = pending.popleft()
+                invalidate = False
+                fate = "aborted"
+                flow = None
+                try:
+                    invalidate = a.commit(entry, speculated=bool(pending))
+                    fate = "committed"
+                    if invalidate and pending and sp:
+                        # the commit decision kills the speculative tail:
+                        # a flow arrow from this span to each invalidated
+                        # chunk renders the causality in the trace viewer
+                        flow = new_flow_id()
+                        sp.add(flow_out=[flow])
+                finally:
+                    sp.end(fate=fate)
+                if invalidate:
+                    while pending:
+                        psp, _ = pending.popleft()
+                        if flow is None:
+                            psp.end(fate="invalidated")
+                        else:
+                            psp.end(fate="invalidated", flow_in=flow)
+                    a.reset_frontier()
+        finally:
+            # a raise mid-loop (guard policy, chaos fault) discards the
+            # in-flight tail unread — their spans still close
+            while pending:
+                psp, _ = pending.popleft()
+                psp.end(fate="abandoned")
         a.finish()
         return a

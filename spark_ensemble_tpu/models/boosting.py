@@ -333,6 +333,7 @@ class _BoostingParams(CheckpointableParams, Estimator):
         class _Adapter(_execution.RoundAdapter):
             def __init__(self):
                 self.depth = depth
+                self.telem = telem  # executor traces chunk spans through it
                 self.i, self.bw, self.stop = i, bw, stop
                 self.i_disp = i
                 self.bw_frontier = bw

@@ -506,6 +506,7 @@ class _GBMParams(CheckpointableParams, Estimator):
         class _Adapter(_execution.RoundAdapter):
             def __init__(self):
                 self.depth = depth
+                self.telem = telem  # executor traces chunk spans through it
                 self.i, self.v, self.best = i, v, best
                 self.halt = False
                 self.i_disp = i  # dispatch frontier (absolute round index)

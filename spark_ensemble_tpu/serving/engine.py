@@ -46,6 +46,7 @@ from spark_ensemble_tpu.telemetry.events import (
     global_metrics,
     serving_stream_id,
 )
+from spark_ensemble_tpu.telemetry.trace import Tracer
 from spark_ensemble_tpu.utils.instrumentation import block_on_arrays
 
 __all__ = ["InferenceEngine"]
@@ -141,6 +142,7 @@ class InferenceEngine:
         self._label = label
         self._telemetry_path = telemetry_path
         self._stream = serving_stream_id(label)
+        self._tracer = Tracer(self._emit_trace, thread=label)
         self._lock = threading.Lock()
         self._compiled: Dict[Tuple[str, int], Any] = {}
         self._compile_s: Dict[Tuple[str, int], float] = {}
@@ -202,6 +204,7 @@ class InferenceEngine:
         eng._label = label
         eng._telemetry_path = self._telemetry_path
         eng._stream = serving_stream_id(label)
+        eng._tracer = Tracer(eng._emit_trace, thread=label)
         eng._lock = threading.Lock()
         eng._compiled = self._compiled
         eng._compile_s = self._compile_s
@@ -223,6 +226,16 @@ class InferenceEngine:
             if n <= b:
                 return b
         return self._max_batch
+
+    def _emit_trace(self, rec: Dict[str, Any]) -> None:
+        # span chokepoint: span records ride the same standalone-event
+        # sinks as engine_warmup/request_served, tagged with this
+        # engine's stream id (telemetry/trace.py; docs/tracing.md)
+        rec = dict(rec)
+        emit_event(
+            rec.pop("event"), path=self._telemetry_path,
+            fit_id=self._stream, **rec,
+        )
 
     def _tier_key(self, method: str, bucket: int, tier: int):
         # full-model programs keep the historical (method, bucket) key so
@@ -249,6 +262,7 @@ class InferenceEngine:
             return getattr(rebuild_model(node, arrays), method)(X)
 
         jitted = jax.jit(run, donate_argnums=(1,) if self._donate else ())
+        wall0 = time.time()
         t0 = time.perf_counter()
         compiled = jitted.lower(
             struct,
@@ -268,6 +282,12 @@ class InferenceEngine:
                 bucket=int(bucket),
                 tier=int(tier),
                 compile_s=compile_s,
+            )
+            # the same tier-warmup as a span on this engine's track, so
+            # the trace shows warmup cost next to the requests it unblocks
+            self._tracer.emit_span(
+                "engine_warmup", wall0, compile_s,
+                method=method, bucket=int(bucket), tier=int(tier),
             )
         return won
 

@@ -60,7 +60,9 @@ from spark_ensemble_tpu.telemetry.events import (
     emit_event,
     global_metrics,
     serving_stream_id,
+    telemetry_sink_active,
 )
+from spark_ensemble_tpu.telemetry.trace import NULL_SPAN, Tracer, new_flow_id
 
 __all__ = [
     "REPLICA_STATES",
@@ -108,7 +110,7 @@ class _FleetRequest:
     __slots__ = (
         "seq", "X", "method", "tier", "deadline_at", "t_submit",
         "future", "outstanding", "replays", "hedged", "hedge_timer",
-        "primary",
+        "primary", "span", "flow_in",
     )
 
     def __init__(self, seq, X, method, tier, deadline_at, t_submit):
@@ -124,6 +126,11 @@ class _FleetRequest:
         self.hedged = False
         self.hedge_timer: Optional[threading.Timer] = None
         self.primary: Optional[str] = None
+        # causal tracing (telemetry/trace.py): the request's span on the
+        # router track, and a (replica_name, flow_id) pair the NEXT serve
+        # on that replica consumes as its incoming hedge/replay arrow
+        self.span = NULL_SPAN
+        self.flow_in: Optional[Tuple[str, int]] = None
 
 
 class _Replica:
@@ -259,7 +266,13 @@ class FleetRouter:
         self._label = label
         self._telemetry_path = telemetry_path
         self._stream = serving_stream_id(label)
+        self._tracer = Tracer(self._emit_trace, thread="router")
+        self._t_start = time.time()
         self._metrics = global_metrics()
+        # live statusz/SLO source: MetricsRegistry.snapshot() pulls this
+        # router's counters on demand (one-stop process snapshot)
+        self._source_name = f"fleet/{self._stream}"
+        self._metrics.register_source(self._source_name, self.slo_snapshot)
         self._lock = threading.Lock()
         self._seq = 0
         self._stopped = False
@@ -297,6 +310,15 @@ class FleetRouter:
         return router
 
     # -- routing -----------------------------------------------------------
+
+    def _emit_trace(self, rec: Dict[str, Any]) -> None:
+        # span chokepoint: spans ride the same standalone-event sinks as
+        # the fleet's SLO events, tagged with this router's stream id
+        rec = dict(rec)
+        emit_event(
+            rec.pop("event"), path=self._telemetry_path,
+            fit_id=self._stream, **rec,
+        )
 
     def _set_state(self, rep: _Replica, state: str, reason: str) -> None:
         # called under self._lock; telemetry goes out band via a timer-free
@@ -407,6 +429,14 @@ class FleetRouter:
                     t0 + deadline_s, t0,
                 )
                 req.primary = rep.name
+                if telemetry_sink_active(self._telemetry_path):
+                    # root of this request's causal tree on the router
+                    # track; ends in _resolve/_fail — on a worker thread,
+                    # so no same-thread jax profiler annotation
+                    req.span = self._tracer.begin_span(
+                        "fleet_request", annotate=False,
+                        seq=self._seq, method=method, tier=tier,
+                    )
                 self._dispatch(req, rep)
         if shed_reason is not None:
             emit_event(
@@ -462,6 +492,12 @@ class FleetRouter:
                 return
             req.hedged = True
             self._counters["hedges_fired"] += 1
+            fid = None
+            if req.span:
+                # flow arrow request-span -> the hedge twin's serve span
+                fid = new_flow_id()
+                req.span.attrs.setdefault("flow_out", []).append(fid)
+                req.flow_in = (rep.name, fid)
             self._dispatch(req, rep)
         emit_event(
             "hedge_fired",
@@ -470,6 +506,7 @@ class FleetRouter:
             seq=req.seq,
             primary=req.primary,
             hedge=rep.name,
+            flow=fid,
         )
         self._metrics.counter("fleet/hedges").inc()
 
@@ -509,6 +546,28 @@ class FleetRouter:
                     return
 
     def _serve_on(self, rep: _Replica, req: _FleetRequest) -> None:
+        # a hedge/replay flow arrow targets ONE replica: only the serve
+        # that actually runs on it consumes the arrow (the original
+        # dispatch on the primary must not claim a hedge's flow id)
+        fid = None
+        fe = req.flow_in
+        if fe is not None and fe[0] == rep.name:
+            fid = fe[1]
+            req.flow_in = None
+        serve_sp = (
+            self._tracer.begin_span(
+                "serve", parent=req.span, thread=rep.name, annotate=False,
+                seq=req.seq, replica=rep.name, tier=req.tier,
+                flow_in=fid,
+            )
+            if req.span else NULL_SPAN
+        )
+        with serve_sp:
+            self._serve_on_inner(rep, req, serve_sp)
+
+    def _serve_on_inner(
+        self, rep: _Replica, req: _FleetRequest, serve_sp
+    ) -> None:
         ctrl = controller()
         site = f"{self._label}:{rep.name}:req{req.seq}"
         stall = ctrl.stall_s(site)
@@ -532,6 +591,7 @@ class FleetRouter:
             latency_ms=(now - req.t_submit) * 1e3,
         )
         delivered = self._resolve(req, resp)
+        serve_sp.add(delivered=delivered, serve_ms=serve_s * 1e3)
         with self._lock:
             rep.inflight -= 1
             req.outstanding -= 1
@@ -591,6 +651,10 @@ class FleetRouter:
             return False  # the other dispatch won; drop, never duplicate
         if req.hedge_timer is not None:
             req.hedge_timer.cancel()
+        req.span.end(
+            replica=resp.replica, hedged=resp.hedged, replays=resp.replays,
+            degraded=resp.degraded, latency_ms=resp.latency_ms,
+        )
         return True
 
     # -- failure / crash handling ------------------------------------------
@@ -639,6 +703,11 @@ class FleetRouter:
             return
         req.replays += 1
         self._counters["replays"] += 1
+        if req.span:
+            # flow arrow request-span -> the replayed serve's span
+            fid = new_flow_id()
+            req.span.attrs.setdefault("flow_out", []).append(fid)
+            req.flow_in = (rep.name, fid)
         self._dispatch(req, rep)
 
     @staticmethod
@@ -647,6 +716,8 @@ class FleetRouter:
             req.future.set_exception(error)
         except InvalidStateError:
             pass  # a racing dispatch delivered first — the caller won
+        else:
+            req.span.end(error=type(error).__name__)
 
     def _on_crash(
         self,
@@ -721,6 +792,7 @@ class FleetRouter:
         if self._stopped:
             return
         self._stopped = True
+        self._metrics.unregister_source(self._source_name)
         self.emit_slo()
         for rep in self._replicas:
             worker = rep.worker
@@ -774,6 +846,45 @@ class FleetRouter:
             }
             out.update(self._counters)
             return out
+
+    def statusz(self) -> Dict[str, Any]:
+        """Live operator view of the fleet — the serving analogue of a
+        /statusz page: identity + uptime, model shape, the per-replica
+        state machines with queue depth and rolling p50/p99, hedge rate,
+        and the zero-steady-state-compile counter.  Built over
+        :meth:`slo_snapshot`, also exported live through
+        ``global_metrics().snapshot()`` as ``fleet/<stream>`` and printed
+        by ``tools/serving_smoke.py fleet``."""
+        snap = self.slo_snapshot()
+        requests = snap["requests"]
+        return {
+            "label": self._label,
+            "stream": self._stream,
+            "trace_id": self._tracer.trace_id,
+            "uptime_s": time.time() - self._t_start,
+            "stopped": self._stopped,
+            "deadline_ms": self._deadline_s * 1e3,
+            "prefix_tiers": list(self._tiers),
+            "pinned": self._registry_release is not None,
+            "model": {
+                "num_members": self._base._packed.num_members,
+                "num_features": self._base._packed.num_features,
+            },
+            "requests": requests,
+            "p50_ms": snap["p50_ms"],
+            "p99_ms": snap["p99_ms"],
+            "hedge_rate": (
+                snap["hedges_fired"] / requests if requests else 0.0
+            ),
+            "compiles_since_warmup": snap["compiles_since_warmup"],
+            "replicas": snap["replicas"],
+            "counters": {
+                k: snap[k] for k in (
+                    "hedges_fired", "hedges_won", "shed", "degraded",
+                    "replays", "crashes",
+                )
+            },
+        }
 
     def emit_slo(self) -> Dict[str, Any]:
         """Emit one ``fleet_slo`` event per replica plus an aggregate row

@@ -21,6 +21,18 @@ from spark_ensemble_tpu.telemetry.events import (
     global_metrics,
     record_fits,
     serving_stream_id,
+    telemetry_sink_active,
+)
+from spark_ensemble_tpu.telemetry.trace import (
+    NULL_SPAN,
+    NULL_TRACER,
+    Span,
+    TraceContext,
+    Tracer,
+    new_flow_id,
+    new_span_id,
+    new_trace_id,
+    trace_annotations_enabled,
 )
 
 __all__ = [
@@ -36,4 +48,14 @@ __all__ = [
     "global_metrics",
     "record_fits",
     "serving_stream_id",
+    "telemetry_sink_active",
+    "Span",
+    "TraceContext",
+    "Tracer",
+    "NULL_SPAN",
+    "NULL_TRACER",
+    "new_trace_id",
+    "new_span_id",
+    "new_flow_id",
+    "trace_annotations_enabled",
 ]

@@ -44,6 +44,15 @@ from typing import Any, Dict, Iterator, List, Optional
 import numpy as np
 
 from spark_ensemble_tpu.telemetry.registry import MetricsRegistry
+from spark_ensemble_tpu.telemetry.trace import (
+    NULL_CONTEXT,
+    NULL_SPAN,
+    Span,
+    TraceContext,
+    Tracer,
+    new_span_id,
+    new_trace_id,
+)
 from spark_ensemble_tpu.utils.instrumentation import block_on_arrays
 
 logger = logging.getLogger("spark_ensemble_tpu")
@@ -76,6 +85,9 @@ SERVING_EVENT_TYPES = (
     "hedge_fired",
     "request_shed",
     "fleet_slo",
+    # causal tracing plane (docs/tracing.md): span records emitted by the
+    # serving fleet / engine ride the same standalone-event chokepoint
+    "span",
 )
 
 # ---------------------------------------------------------------------------
@@ -273,6 +285,15 @@ def serving_stream_id(label: str = "serving") -> str:
     return f"{label}:{os.getpid()}:{next(_STREAM_SEQ)}"
 
 
+def telemetry_sink_active(path: Optional[str] = None) -> bool:
+    """Whether :func:`emit_event` with this ``path`` would reach any sink
+    — the cheap pre-check hot paths use to skip building span objects
+    entirely when nobody is listening (docs/tracing.md)."""
+    return bool(
+        path or os.environ.get(TELEMETRY_ENV) or _active_recorder() is not None
+    )
+
+
 def emit_event(event: str, path: Optional[str] = None, **fields) -> None:
     """Emit one standalone structured event (``model_packed``,
     ``engine_warmup``, ``request_served``, ...) through the same sinks as
@@ -322,6 +343,14 @@ class FitTelemetry:
         self._finished = False
         self._t0 = time.perf_counter()
         self._last_mark = self._t0
+        # causal tracing plane (telemetry/trace.py): every fit is one
+        # trace; the root "fit" span's id is allocated up front so child
+        # spans (round chunks, shard waits, checkpoint saves) can parent
+        # to it before the root itself is emitted at finish()/abort()
+        self.trace_id = new_trace_id()
+        self._root_span_id = new_span_id()
+        self._ts0 = time.time()
+        self._tracer = Tracer(self._emit, trace_id=self.trace_id)
         _ensure_compile_listener()
         self._compile0 = compile_snapshot()
 
@@ -408,6 +437,51 @@ class FitTelemetry:
         t0 = time.perf_counter()
         block_on_arrays(fence)
         self.host_blocked(time.perf_counter() - t0)
+
+    # -- causal tracing (telemetry/trace.py; docs/tracing.md) -------------
+
+    def trace_context(self) -> TraceContext:
+        """Propagation handle for a child span begun on another thread
+        (checkpoint writer, prefetch reconstruction): parents to the
+        fit's root span."""
+        return TraceContext(self.trace_id, self._root_span_id)
+
+    def begin_span(self, name: str, parent=None, thread=None,
+                   annotate: bool = True, **attrs) -> Span:
+        """Start a span on this fit's trace (defaults to a child of the
+        root "fit" span).  The caller must guarantee ``end()`` on every
+        path — ``with`` or try/finally (graftlint ``unclosed-span``)."""
+        if parent is None:
+            parent = self.trace_context()
+        return self._tracer.begin_span(
+            name, parent=parent, thread=thread, annotate=annotate, **attrs
+        )
+
+    def emit_span(self, name: str, ts: float, dur_s: float, parent=None,
+                  thread=None, **fields) -> str:
+        """Emit an already-measured span (work done on a thread that must
+        stay telemetry-free, e.g. the shard-prefetch worker); returns the
+        span id for further parenting."""
+        if parent is None:
+            parent = self.trace_context()
+        return self._tracer.emit_span(
+            name, ts, dur_s, parent=parent, thread=thread, **fields
+        )
+
+    def _emit_root_span(self, wall: float, **attrs) -> None:
+        rec: Dict[str, Any] = {
+            "event": "span",
+            "name": "fit",
+            "trace_id": self.trace_id,
+            "span_id": self._root_span_id,
+            "parent_id": "",
+            "ts": self._ts0,
+            "dur_s": wall,
+            "pid": os.getpid(),
+            "family": self.family,
+        }
+        rec.update(attrs)
+        self._emit(rec)
 
     def round_chunk(self, start_round: int, count: int, t0: float,
                     fence: Any = (), losses: Any = None, step_sizes: Any = None,
@@ -547,6 +621,7 @@ class FitTelemetry:
         if mem:
             ev["memory"] = mem
         ev.update(outcome)
+        self._emit_root_span(wall, rounds=self._rounds)
         self._emit(ev)
         if self._path:
             with self._lock:
@@ -578,6 +653,7 @@ class FitTelemetry:
             "phases": phases,
         }
         ev.update(outcome)
+        self._emit_root_span(wall, error=type(error).__name__)
         self._emit(ev)
         if self._path:
             with self._lock:
@@ -632,18 +708,50 @@ class FitTelemetry:
 
 
 class _DisabledFitTelemetry(FitTelemetry):
-    """Shared no-op: every method returns immediately, no state mutates."""
+    """Shared no-op: every method returns immediately, no state mutates.
+
+    Audit discipline: every ``FitTelemetry`` method with side effects or
+    allocations must be overridden here — inherited implementations run
+    against state this ``__init__`` never creates.  The inherited
+    surface as of the tracing plane: ``start``/``phases_enabled``
+    (class/static, sinkless), ``span`` (overridden), everything else
+    overridden below.  ``round_chunk``/``host_blocked`` take ``*a, **kw``
+    /positional so their kwarg drift since PR 1 (``divisor``,
+    ``round_cost``, ``phase``) cannot break the disabled path."""
 
     enabled = False
+    trace_id = ""
 
     def __init__(self):  # noqa: D401 - deliberately skip parent init
         self.family = ""
         self.fit_id = ""
 
+    def emit(self, event, **fields):
+        # override: the inherited emit() builds the event dict before
+        # handing it to _emit — a dead allocation on every robustness
+        # event when telemetry is off
+        pass
+
     def _emit(self, event):
         pass
 
     def phase_mark(self, name):
+        pass
+
+    # -- tracing: hand out the shared null objects, allocate nothing ------
+
+    def trace_context(self):
+        return NULL_CONTEXT
+
+    def begin_span(self, name, parent=None, thread=None, annotate=True,
+                   **attrs):
+        return NULL_SPAN
+
+    def emit_span(self, name, ts, dur_s, parent=None, thread=None,
+                  **fields):
+        return ""
+
+    def _emit_root_span(self, wall, **attrs):
         pass
 
     @contextlib.contextmanager

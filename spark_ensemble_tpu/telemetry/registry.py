@@ -201,6 +201,7 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: Dict[str, Any] = {}
+        self._sources: Dict[str, Any] = {}
 
     def _get_or_create(self, name: str, cls, factory):
         with self._lock:
@@ -232,12 +233,39 @@ class MetricsRegistry:
         metric kinds they are NOT shared between callers."""
         return RoundTimer(name, self.histogram(name))
 
+    def register_source(self, name: str, fn) -> None:
+        """Register a LIVE snapshot source: ``fn()`` returns a JSON-ready
+        value rendered into :meth:`snapshot` under ``name`` — how
+        long-lived stateful objects (a ``FleetRouter``'s SLO counters)
+        surface through the one-stop process snapshot without mirroring
+        every update into counters.  Re-registering a name replaces the
+        source; the owner unregisters on shutdown."""
+        with self._lock:
+            self._sources[name] = fn
+
+    def unregister_source(self, name: str) -> None:
+        with self._lock:
+            self._sources.pop(name, None)
+
     def names(self) -> List[str]:
         with self._lock:
             return sorted(self._metrics)
 
     def snapshot(self) -> Dict[str, Dict[str, Any]]:
-        """Point-in-time values of every metric, JSON-ready."""
+        """Point-in-time values of every metric plus every registered
+        live source, JSON-ready.  Source callables run OUTSIDE the
+        registry lock (they may take their owner's lock); a source that
+        raises reports its error instead of poisoning the snapshot."""
         with self._lock:
             items: List[Tuple[str, Any]] = sorted(self._metrics.items())
-        return {name: m.snapshot() for name, m in items}
+            sources: List[Tuple[str, Any]] = sorted(self._sources.items())
+        out = {name: m.snapshot() for name, m in items}
+        for name, fn in sources:
+            try:
+                out[name] = {"type": "source", "value": fn()}
+            except Exception as e:  # noqa: BLE001 - snapshot must not die
+                out[name] = {
+                    "type": "source",
+                    "error": f"{type(e).__name__}: {e}",
+                }
+        return out

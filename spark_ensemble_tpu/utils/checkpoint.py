@@ -30,6 +30,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from spark_ensemble_tpu.telemetry.trace import NULL_SPAN
+
 logger = logging.getLogger("spark_ensemble_tpu")
 
 
@@ -148,26 +150,40 @@ class TrainingCheckpointer:
             self._executor = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="ckpt-writer"
             )
+        # explicit trace-context capture ON THE FIT THREAD: the writer
+        # thread parents its checkpoint_save span to this fit's root span
+        # through the two propagated ids (telemetry/trace.py)
+        ctx = None if self.telem is None else self.telem.trace_context()
         self._pending = self._executor.submit(
-            self._save_sync, round_idx, state
+            self._save_sync, round_idx, state, ctx
         )
 
-    def _save_sync(self, round_idx: int, state: Dict[str, Any]) -> None:
+    def _save_sync(self, round_idx: int, state: Dict[str, Any],
+                   parent=None) -> None:
         from spark_ensemble_tpu.robustness.chaos import controller
         from spark_ensemble_tpu.robustness.retry import retry_call
 
-        retry_call(
-            lambda: self._write(round_idx, state),
-            policy=self.retry_policy,
-            op="checkpoint.save",
-            telem=self.telem,
+        sp = NULL_SPAN if self.telem is None else self.telem.begin_span(
+            "checkpoint_save", parent=parent,
+            thread="ckpt-writer" if parent is not None else None,
+            round=round_idx,
         )
-        # chaos hook: simulate a crash mid-write AFTER the swap — exactly
-        # the torn state load_latest's manifest check must recover from
-        controller().corrupt_checkpoint(
-            f"ckpt:{self.directory}:{round_idx}",
-            os.path.join(self.directory, "latest", "state.json"),
-        )
+        try:
+            retry_call(
+                lambda: self._write(round_idx, state),
+                policy=self.retry_policy,
+                op="checkpoint.save",
+                telem=self.telem,
+            )
+            # chaos hook: simulate a crash mid-write AFTER the swap —
+            # exactly the torn state load_latest's manifest check must
+            # recover from
+            controller().corrupt_checkpoint(
+                f"ckpt:{self.directory}:{round_idx}",
+                os.path.join(self.directory, "latest", "state.json"),
+            )
+        finally:
+            sp.end()
 
     def _write(self, round_idx: int, state: Dict[str, Any]) -> None:
         from spark_ensemble_tpu.utils.persist import _encode

@@ -1,0 +1,213 @@
+"""Perf-regression sentinel tests (tools/perf_sentinel.py;
+docs/tracing.md#perf-sentinel): direction-aware noise floors, the
+platform-mismatch and missing-metric skip rules, baseline updates, and
+the CLI exit codes CI gates on — including the ISSUE-pinned pair: a
+synthetic regressed record FAILS while the repo's real newest bench
+record PASSES against the committed ``PERF_BASELINE.json``."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "perf_sentinel", os.path.join(_ROOT, "tools", "perf_sentinel.py")
+)
+sentinel = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(sentinel)
+
+
+BASE = {
+    "platform": "cpu",
+    "value": 10.0,
+    "fit_seconds": 5.0,
+    "predict_rows_per_sec": 10_000.0,
+    "serving_p99_ms": 8.0,
+    "compiles_since_warmup": 0,
+    "trace_overhead_pct": 0.2,
+}
+
+
+def _names(rows):
+    return {r["metric"] for r in rows}
+
+
+# ---------------------------------------------------------------------------
+# compare(): direction + floors
+# ---------------------------------------------------------------------------
+
+
+def test_identical_record_is_clean():
+    v = sentinel.compare(BASE, dict(BASE))
+    assert v["regressions"] == []
+    assert _names(v["ok"]) == set(BASE) - {"platform"}
+
+
+def test_higher_is_better_regression_fires():
+    bench = dict(BASE, value=5.0)  # half the throughput: way past 10%
+    v = sentinel.compare(BASE, bench)
+    assert _names(v["regressions"]) == {"value"}
+    (row,) = v["regressions"]
+    assert row["direction"] == "higher"
+    assert row["worse_by"] == pytest.approx(5.0)
+
+
+def test_lower_is_better_regression_fires():
+    bench = dict(BASE, fit_seconds=8.0, serving_p99_ms=30.0)
+    v = sentinel.compare(BASE, bench)
+    assert _names(v["regressions"]) == {"fit_seconds", "serving_p99_ms"}
+
+
+def test_noise_floor_absorbs_jitter():
+    # value -5% (floor 10%), fit_seconds +0.3s (abs floor 0.5s),
+    # p99 +0.5ms (abs floor 1.0ms): all inside the floors
+    bench = dict(
+        BASE, value=9.5, fit_seconds=5.3, serving_p99_ms=8.5,
+    )
+    v = sentinel.compare(BASE, bench)
+    assert v["regressions"] == []
+
+
+def test_improvements_never_fail():
+    bench = dict(
+        BASE, value=20.0, fit_seconds=1.0, serving_p99_ms=2.0,
+        trace_overhead_pct=0.0,
+    )
+    assert sentinel.compare(BASE, bench)["regressions"] == []
+
+
+def test_zero_compile_contract_has_no_floor():
+    # compiles_since_warmup pins EXACTLY zero: one steady-state compile
+    # is a regression, not jitter
+    v = sentinel.compare(BASE, dict(BASE, compiles_since_warmup=1))
+    assert _names(v["regressions"]) == {"compiles_since_warmup"}
+
+
+def test_missing_metric_skips_with_note():
+    bench = {"platform": "cpu", "value": 10.0}
+    v = sentinel.compare(BASE, bench)
+    assert v["regressions"] == []
+    assert _names(v["ok"]) == {"value"}
+    assert _names(v["skipped"]) == set(BASE) - {"platform", "value"}
+    assert all("absent" in r["note"] for r in v["skipped"])
+
+
+def test_non_numeric_metric_skips():
+    v = sentinel.compare(BASE, dict(BASE, value="NaN-ish"))
+    assert v["regressions"] == []
+    assert "value" in _names(v["skipped"])
+
+
+def test_platform_mismatch_skips_everything():
+    bench = dict(BASE, platform="tpu", value=0.001)  # terrible, but...
+    v = sentinel.compare(BASE, bench)
+    assert v["regressions"] == [] and v["ok"] == []
+    (row,) = v["skipped"]
+    assert row["metric"] == "*" and "platform_mismatch" in row["note"]
+
+
+def test_unpinned_baseline_metric_is_ignored():
+    base = {"platform": "cpu", "value": 10.0}  # only one metric pinned
+    v = sentinel.compare(base, dict(BASE, fit_seconds=500.0))
+    assert v["regressions"] == []
+    assert _names(v["ok"]) == {"value"}
+
+
+# ---------------------------------------------------------------------------
+# payload loading + baseline update
+# ---------------------------------------------------------------------------
+
+
+def test_load_bench_unwraps_driver_parsed_wrapper(tmp_path):
+    p = tmp_path / "BENCH_r01.json"
+    p.write_text(json.dumps({"round": 1, "parsed": dict(BASE)}))
+    assert sentinel.load_bench(str(p)) == BASE
+    raw = tmp_path / "raw.json"
+    raw.write_text(json.dumps(BASE))
+    assert sentinel.load_bench(str(raw)) == BASE
+
+
+def test_newest_bench_sorts_by_round(tmp_path):
+    for r in (3, 11, 7):
+        (tmp_path / f"BENCH_r{r:02d}.json").write_text("{}")
+    assert sentinel.newest_bench(str(tmp_path)).endswith("BENCH_r11.json")
+    assert sentinel.newest_bench(str(tmp_path / "empty")) is None
+
+
+def test_update_baseline_writes_compared_metrics_only(tmp_path):
+    path = str(tmp_path / "PERF_BASELINE.json")
+    bench = dict(BASE, device="TFRT_CPU_0", error="", extra_junk=1)
+    written = sentinel.update_baseline(bench, path)
+    on_disk = json.loads(open(path).read())
+    assert on_disk == written
+    assert set(written) == set(BASE) | {"source"}
+    assert written["source"] == "TFRT_CPU_0"
+    assert "extra_junk" not in written and "error" not in written
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes (what CI gates on)
+# ---------------------------------------------------------------------------
+
+
+def _write(tmp_path, name, payload):
+    p = tmp_path / name
+    p.write_text(json.dumps(payload))
+    return str(p)
+
+
+def test_cli_fails_on_synthetic_regressed_record(tmp_path, capsys):
+    baseline = _write(tmp_path, "PERF_BASELINE.json", BASE)
+    bench = _write(
+        tmp_path, "BENCH_r99.json",
+        {"parsed": dict(BASE, value=BASE["value"] * 0.5)},
+    )
+    rc = sentinel.main(["--bench", bench, "--baseline", baseline])
+    assert rc == 1
+    captured = capsys.readouterr()
+    assert "PERF REGRESSION" in captured.err
+    assert "--update-baseline" in captured.err  # the documented escape hatch
+    assert _names(json.loads(captured.out)["regressions"]) == {"value"}
+
+
+def test_cli_passes_on_clean_record(tmp_path, capsys):
+    baseline = _write(tmp_path, "PERF_BASELINE.json", BASE)
+    bench = _write(tmp_path, "BENCH_r99.json", dict(BASE))
+    assert sentinel.main(["--bench", bench, "--baseline", baseline]) == 0
+    assert json.loads(capsys.readouterr().out)["regressions"] == []
+
+
+def test_cli_update_baseline_roundtrip(tmp_path, capsys):
+    baseline = str(tmp_path / "PERF_BASELINE.json")
+    bench = _write(tmp_path, "BENCH_r99.json", dict(BASE))
+    rc = sentinel.main(
+        ["--bench", bench, "--baseline", baseline, "--update-baseline"]
+    )
+    assert rc == 0 and os.path.exists(baseline)
+    capsys.readouterr()
+    # a fresh baseline from a record compares clean against that record
+    assert sentinel.main(["--bench", bench, "--baseline", baseline]) == 0
+
+
+def test_cli_missing_baseline_or_bench_skips(tmp_path, capsys):
+    bench = _write(tmp_path, "BENCH_r99.json", dict(BASE))
+    missing = str(tmp_path / "nope.json")
+    assert sentinel.main(["--bench", bench, "--baseline", missing]) == 0
+    assert "skipped" in json.loads(capsys.readouterr().out)
+
+
+def test_repo_real_bench_passes_committed_baseline(capsys):
+    """The acceptance pair's other half: the repo's own newest bench
+    record must compare clean against the committed baseline (CI runs
+    exactly this invocation)."""
+    newest = sentinel.newest_bench()
+    committed = os.path.join(_ROOT, "PERF_BASELINE.json")
+    if newest is None or not os.path.exists(committed):
+        pytest.skip("no committed bench record / baseline in this checkout")
+    assert sentinel.main(["--bench", newest, "--baseline", committed]) == 0
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["regressions"] == []
+    assert verdict["ok"], "baseline and bench share no comparable metric"

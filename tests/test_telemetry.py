@@ -341,3 +341,74 @@ def test_report_cli_renders_shard_io_line(tmp_path, capsys):
     assert "shard I/O:" in out
     assert "prefetch hits" in out
     assert "wait share" in out
+
+
+# ---------------------------------------------------------------------------
+# live snapshot sources + the process-global metric key contract
+# ---------------------------------------------------------------------------
+
+
+def test_register_source_renders_live_value_in_snapshot():
+    reg = MetricsRegistry()
+    reg.counter("x").inc(3)
+    state = {"requests": 0}
+    reg.register_source("svc/live", lambda: dict(state))
+    state["requests"] = 7  # the source is LIVE: read at snapshot time
+    snap = reg.snapshot()
+    assert snap["x"] == {"type": "counter", "value": 3}
+    assert snap["svc/live"] == {
+        "type": "source", "value": {"requests": 7},
+    }
+    # re-registering replaces; unregistering removes
+    reg.register_source("svc/live", lambda: "v2")
+    assert reg.snapshot()["svc/live"]["value"] == "v2"
+    reg.unregister_source("svc/live")
+    assert "svc/live" not in reg.snapshot()
+    reg.unregister_source("svc/live")  # idempotent
+
+
+def test_source_error_is_captured_not_raised():
+    reg = MetricsRegistry()
+
+    def _boom():
+        raise RuntimeError("owner is gone")
+
+    reg.register_source("svc/bad", _boom)
+    snap = reg.snapshot()
+    assert snap["svc/bad"]["type"] == "source"
+    assert "RuntimeError: owner is gone" in snap["svc/bad"]["error"]
+    assert "value" not in snap["svc/bad"]
+
+
+def test_shard_io_mirrors_into_global_metrics(tmp_path):
+    """Satellite contract (docs/tracing.md): every prefetcher sweep lands
+    in ``global_metrics()`` under STABLE ``data/shard_*`` keys, telemetry
+    sink or not — the process snapshot is the one-stop operator view."""
+    from spark_ensemble_tpu.data import ShardPrefetcher, write_shards
+    from spark_ensemble_tpu.telemetry import global_metrics
+
+    X, _ = _data()
+    store = write_shards(X, str(tmp_path / "store"), max_bins=16,
+                         shard_rows=64)
+    g = global_metrics()
+    loads0 = g.counter("data/shard_loads").value
+    bytes0 = g.counter("data/shard_bytes").value
+    with ShardPrefetcher(store, depth=1, to_device=False) as pf:
+        taken = sum(1 for _ in pf.sweep())
+        stats = pf.take_stats()
+    assert taken == store.num_shards
+    # take_stats drains the per-fit ledger...
+    assert stats["loads"] == store.num_shards and stats["bytes"] > 0
+    assert stats["hits"] + stats["misses"] == store.num_shards
+    assert pf.take_stats()["loads"] == 0
+    # ...while the global mirror accumulates under the pinned keys
+    snap = g.snapshot()
+    for key in ("data/shard_loads", "data/shard_bytes",
+                "data/shard_load_s", "data/shard_wait_s"):
+        assert key in snap, f"stable snapshot key {key} missing"
+    assert snap["data/shard_loads"]["value"] - loads0 == store.num_shards
+    assert snap["data/shard_bytes"]["value"] - bytes0 == stats["bytes"]
+    assert snap["data/shard_load_s"]["type"] == "histogram"
+    hits = snap.get("data/shard_prefetch_hits", {}).get("value", 0)
+    misses = snap.get("data/shard_prefetch_misses", {}).get("value", 0)
+    assert hits + misses >= store.num_shards

@@ -1,0 +1,65 @@
+"""Golden-output test for ``tools/telemetry_report.py``: a canned JSONL
+stream (fit + streaming shard-I/O + fleet SLO events, with ``span``
+rows interleaved) renders byte-identical to the committed golden.  The
+span events are the tracing plane riding the same stream
+(docs/tracing.md) — the report must keep working over them unchanged,
+which is exactly what the golden pins."""
+
+import importlib.util
+import json
+import os
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIX = os.path.join(_ROOT, "tests", "fixtures", "telemetry")
+
+spec = importlib.util.spec_from_file_location(
+    "telemetry_report", os.path.join(_ROOT, "tools", "telemetry_report.py")
+)
+report = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(report)
+
+CANNED = os.path.join(_FIX, "canned.jsonl")
+GOLDEN = os.path.join(_FIX, "canned_report.golden")
+
+
+def test_canned_stream_renders_golden(capsys):
+    assert report.main([CANNED]) == 0
+    got = capsys.readouterr().out
+    want = open(GOLDEN).read()
+    assert got == want, (
+        "telemetry_report output drifted from the golden; if the change "
+        "is deliberate, regenerate with:\n  python tools/telemetry_report.py "
+        "tests/fixtures/telemetry/canned.jsonl > "
+        "tests/fixtures/telemetry/canned_report.golden"
+    )
+
+
+def test_span_rows_do_not_leak_into_the_report():
+    events = report.load_events(CANNED)
+    spans = [e for e in events if e.get("event") == "span"]
+    assert spans, "fixture must interleave span rows"
+    fits = report.group_fits(events)
+    rendered = report.render_fit(
+        "GBMRegressor:1:0", fits["GBMRegressor:1:0"]
+    )
+    # spans group under their fit but contribute no rows of their own
+    assert "span" not in rendered
+    assert "round_chunk" not in rendered
+
+
+def test_fit_filter_and_aggregate_jsonl(tmp_path, capsys):
+    out = tmp_path / "agg.jsonl"
+    assert report.main([CANNED, "--fit", "GBMRegressor",
+                        "--jsonl", str(out)]) == 0
+    text = capsys.readouterr().out
+    assert "== GBMRegressor:1:0 ==" in text
+    assert "serving:1:0" not in text  # filtered out
+    records = [json.loads(line) for line in out.read_text().splitlines()]
+    assert [r["op"] for r in records] == ["rounds", "setup", "finalize"]
+    assert records[0] == {
+        "count": 2, "op": "rounds", "share": 0.5, "total_us": 100000.0,
+    }
+
+
+def test_missing_fit_filter_fails(capsys):
+    assert report.main([CANNED, "--fit", "nope"]) == 1

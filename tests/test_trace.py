@@ -1,0 +1,588 @@
+"""Causal tracing plane tests (docs/tracing.md): span primitives and
+propagation, the executor's chunk fates (committed / invalidated /
+abandoned) with invalidation flow arrows, cross-thread spans from the
+shard prefetcher and the checkpoint writer, the fleet's request/serve
+spans with hedge flows and live ``statusz()``, the disabled path's
+null objects, and ``tools/trace_viewer.py``'s validated Perfetto
+export — including the ISSUE-pinned acceptance: a chaos run whose
+exported trace contains a test-asserted hedge flow arrow and an
+invalidated speculative chunk, with every parent/flow id resolving."""
+
+import importlib.util
+import json
+import os
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import spark_ensemble_tpu as se
+from spark_ensemble_tpu.data import ShardPrefetcher, write_shards
+from spark_ensemble_tpu.execution import RoundAdapter, RoundExecutor
+from spark_ensemble_tpu.robustness.chaos import ChaosController, install
+from spark_ensemble_tpu.serving import FleetRouter
+from spark_ensemble_tpu.telemetry import (
+    NULL_SPAN,
+    NULL_TRACER,
+    TraceContext,
+    Tracer,
+    record_fits,
+    telemetry_sink_active,
+)
+from spark_ensemble_tpu.telemetry.events import (
+    _DISABLED,
+    FitTelemetry,
+    emit_event,
+)
+from spark_ensemble_tpu.telemetry.trace import (
+    NULL_CONTEXT,
+    new_flow_id,
+    new_span_id,
+    new_trace_id,
+    trace_annotations_enabled,
+)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, "tools", name + ".py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+viewer = _load_tool("trace_viewer")
+
+ROUNDS = 5
+
+
+def _data(n=96, d=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X @ rng.randn(d) + 0.1 * rng.randn(n)).astype(np.float32)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    X, y = _data()
+    model = se.GBMRegressor(num_base_learners=ROUNDS, seed=0).fit(X, y)
+    return X, y, model
+
+
+@pytest.fixture(autouse=True)
+def _deterministic_chaos():
+    # same discipline as tests/test_fleet.py: the chaos tests below
+    # install their own controllers; everything else must see silence
+    install(ChaosController(seed=0, rate=0.0))
+    yield
+    install(None)
+
+
+def _spans(events, name=None):
+    out = [e for e in events if e.get("event") == "span"]
+    if name:
+        out = [s for s in out if s.get("name") == name]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# primitives: ids, Span lifecycle, propagation, null objects
+# ---------------------------------------------------------------------------
+
+
+def test_ids_are_unique_and_pid_scoped():
+    traces = {new_trace_id() for _ in range(50)}
+    spans = {new_span_id() for _ in range(50)}
+    flows = {new_flow_id() for _ in range(50)}
+    assert len(traces) == 50 and len(spans) == 50 and len(flows) == 50
+    pid = os.getpid()
+    assert all(t.startswith(f"t{pid:x}.") for t in traces)
+    assert all(s.startswith(f"s{pid:x}.") for s in spans)
+    assert all(isinstance(f, int) and (f >> 24) == pid for f in flows)
+
+
+def test_span_lifecycle_and_idempotent_end():
+    sink = []
+    tracer = Tracer(sink.append, thread="fit")
+    with tracer.begin_span("fit", family="test") as root:
+        root.add(rounds=3)
+        with tracer.begin_span("round_chunk", parent=root, chunk_seq=0):
+            pass
+    root.end(ignored=True)  # second end: no duplicate record, no attr
+    assert [s["name"] for s in sink] == ["round_chunk", "fit"]
+    chunk, fit = sink
+    assert fit["trace_id"] == tracer.trace_id
+    assert fit["parent_id"] == ""
+    assert fit["rounds"] == 3 and "ignored" not in fit
+    assert chunk["parent_id"] == fit["span_id"]
+    assert chunk["trace_id"] == fit["trace_id"]
+    assert chunk["thread"] == "fit"
+    assert chunk["dur_s"] >= 0.0 and chunk["ts"] <= fit["ts"] + fit["dur_s"]
+
+
+def test_span_exception_records_error_attr():
+    sink = []
+    tracer = Tracer(sink.append)
+    with pytest.raises(ValueError):
+        with tracer.begin_span("serve"):
+            raise ValueError("boom")
+    (rec,) = sink
+    assert rec["error"] == "ValueError"
+
+
+def test_context_propagation_across_threads():
+    sink = []
+    tracer = Tracer(sink.append)
+    with tracer.begin_span("fit") as root:
+        ctx = root.context()
+        assert isinstance(ctx, TraceContext) and ctx
+        # the far side: a different Tracer (different default trace)
+        # still lands on the ORIGIN trace through the two captured ids
+        other = Tracer(sink.append, thread="ckpt-writer")
+        with other.begin_span("checkpoint_save", parent=ctx, round=2):
+            pass
+        sid = other.emit_span(
+            "shard_load", 12.0, 0.5, parent=ctx, thread="se-tpu-shard",
+            flow_out=[7], shard=0,
+        )
+    ckpt = _spans(sink, "checkpoint_save")[0]
+    load = _spans(sink, "shard_load")[0]
+    fit = _spans(sink, "fit")[0]
+    for child in (ckpt, load):
+        assert child["trace_id"] == tracer.trace_id
+        assert child["parent_id"] == fit["span_id"]
+    assert ckpt["thread"] == "ckpt-writer"
+    assert load["thread"] == "se-tpu-shard"
+    assert load["span_id"] == sid
+    assert load["ts"] == 12.0 and load["dur_s"] == 0.5
+    assert load["flow_out"] == [7]
+    assert viewer.validate(_spans(sink)) == []
+
+
+def test_null_objects_are_falsy_no_ops():
+    assert not NULL_SPAN and not NULL_TRACER and not NULL_CONTEXT
+    assert NULL_TRACER.begin_span("x", attr=1) is NULL_SPAN
+    assert NULL_TRACER.emit_span("x", 0.0, 1.0) == ""
+    with NULL_SPAN as sp:
+        sp.add(a=1)
+        assert sp.context() is NULL_CONTEXT
+    NULL_SPAN.end()  # nothing to flush, nothing raised
+    # a real span is truthy — the `if req.span:` hot-path guard
+    assert Tracer(lambda rec: None).begin_span("y")
+
+
+def test_disabled_telemetry_hands_out_nulls():
+    assert _DISABLED.begin_span("round_chunk", chunk_seq=0) is NULL_SPAN
+    assert _DISABLED.emit_span("shard_load", 0.0, 1.0) == ""
+    assert _DISABLED.trace_context() is NULL_CONTEXT
+    assert _DISABLED.trace_id == ""
+
+
+def test_telemetry_sink_active(monkeypatch, tmp_path):
+    monkeypatch.delenv("SE_TPU_TELEMETRY", raising=False)
+    assert not telemetry_sink_active()
+    assert telemetry_sink_active(str(tmp_path / "t.jsonl"))
+    with record_fits():
+        assert telemetry_sink_active()
+    monkeypatch.setenv("SE_TPU_TELEMETRY", str(tmp_path / "env.jsonl"))
+    assert telemetry_sink_active()
+
+
+def test_trace_annotations_env_gate(monkeypatch):
+    monkeypatch.delenv("SE_TPU_TRACE_ANNOTATIONS", raising=False)
+    assert not trace_annotations_enabled()
+    monkeypatch.setenv("SE_TPU_TRACE_ANNOTATIONS", "1")
+    assert trace_annotations_enabled()
+    # annotated spans still emit normally outside a profiler capture
+    sink = []
+    with Tracer(sink.append).begin_span("fit"):
+        pass
+    assert len(sink) == 1
+
+
+# ---------------------------------------------------------------------------
+# RoundExecutor chunk fates
+# ---------------------------------------------------------------------------
+
+
+class _ScriptedAdapter(RoundAdapter):
+    """Deterministic adapter: `total` chunks; committing a chunk listed in
+    `invalidate_at` (by absolute chunk index) kills the in-flight tail."""
+
+    def __init__(self, telem, total=5, depth=2, invalidate_at=(),
+                 raise_at=None):
+        self.telem = telem
+        self.depth = depth
+        self.total = total
+        self.invalidate_at = set(invalidate_at)
+        self.raise_at = raise_at
+        self.committed = 0
+        self.frontier = 0
+        self.finished = False
+
+    def should_continue(self):
+        return self.committed < self.total
+
+    def can_launch(self):
+        return self.frontier < self.total
+
+    def launch(self):
+        entry = self.frontier
+        self.frontier += 1
+        return entry
+
+    def commit(self, entry, speculated):
+        if self.raise_at is not None and entry == self.raise_at:
+            raise RuntimeError("chaos mid-commit")
+        self.committed = entry + 1
+        return entry in self.invalidate_at
+
+    def reset_frontier(self):
+        self.frontier = self.committed
+
+    def finish(self):
+        self.finished = True
+
+
+def test_executor_invalidation_fates_and_flow():
+    sink = []
+    adapter = _ScriptedAdapter(
+        Tracer(sink.append, thread="fit"), total=5, depth=2,
+        invalidate_at=(0,),
+    )
+    RoundExecutor(adapter).run()
+    assert adapter.finished and adapter.committed == 5
+    chunks = _spans(sink, "round_chunk")
+    fates = Counter(s["fate"] for s in chunks)
+    # window 3: launch 0,1,2; committing 0 invalidates 1,2 in flight;
+    # then 1..4 relaunch and commit cleanly — 5 committed + 2 invalidated
+    assert fates == {"committed": 5, "invalidated": 2}
+    killer = [
+        s for s in chunks if s["fate"] == "committed" and s.get("flow_out")
+    ]
+    assert len(killer) == 1
+    (flow,) = killer[0]["flow_out"]
+    invalidated = [s for s in chunks if s["fate"] == "invalidated"]
+    assert all(s["flow_in"] == flow for s in invalidated)
+    # the invalidated chunks were dispatched speculatively
+    assert all(s["speculative"] for s in invalidated)
+    assert viewer.validate(chunks) == []
+
+
+def test_executor_abandons_in_flight_spans_on_raise():
+    sink = []
+    adapter = _ScriptedAdapter(
+        Tracer(sink.append), total=5, depth=2, raise_at=1,
+    )
+    with pytest.raises(RuntimeError, match="chaos"):
+        RoundExecutor(adapter).run()
+    assert not adapter.finished  # finish() only runs on a clean exit
+    fates = Counter(s["fate"] for s in _spans(sink, "round_chunk"))
+    assert fates["committed"] == 1  # chunk 0
+    assert fates["aborted"] == 1    # chunk 1 raised mid-commit
+    assert fates["abandoned"] >= 1  # the speculative tail, closed unread
+    assert fates.get("invalidated", 0) == 0
+
+
+def test_executor_without_telem_traces_nothing():
+    adapter = _ScriptedAdapter(None, total=3, depth=1)
+    RoundExecutor(adapter).run()
+    assert adapter.finished and adapter.committed == 3
+
+
+# ---------------------------------------------------------------------------
+# fit integration: root span, chunk spans, checkpoint + prefetch threads
+# ---------------------------------------------------------------------------
+
+
+def test_fit_emits_rooted_round_chunk_spans():
+    X, y = _data()
+    with record_fits() as rec:
+        se.GBMRegressor(num_base_learners=4, seed=0, scan_chunk=2).fit(X, y)
+    spans = _spans(rec.events)
+    roots = _spans(spans, "fit")
+    assert len(roots) == 1
+    root = roots[0]
+    assert root["parent_id"] == "" and root["rounds"] == 4
+    chunks = _spans(spans, "round_chunk")
+    assert len(chunks) >= 2  # 4 rounds in scan_chunk=2 dispatches
+    for s in chunks:
+        assert s["trace_id"] == root["trace_id"]
+        assert s["parent_id"] == root["span_id"]
+        assert s["fate"] == "committed"
+    assert viewer.validate(spans) == []
+
+
+def test_checkpoint_save_span_on_writer_thread(tmp_path):
+    X, y = _data()
+    with record_fits() as rec:
+        se.GBMRegressor(
+            num_base_learners=4, seed=0, scan_chunk=2,
+            checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_interval=2,
+        ).fit(X, y)
+    spans = _spans(rec.events)
+    saves = _spans(spans, "checkpoint_save")
+    assert saves, "checkpointed fit emitted no checkpoint_save spans"
+    root = _spans(spans, "fit")[0]
+    for s in saves:
+        assert s["trace_id"] == root["trace_id"]
+        assert s["parent_id"] == root["span_id"]
+        assert s["thread"] == "ckpt-writer"
+        assert s["round"] >= 0
+    assert viewer.validate(spans) == []
+
+
+def test_prefetcher_reconstructs_worker_spans(tmp_path):
+    X, _ = _data(n=157)
+    store = write_shards(
+        X, str(tmp_path / "store"), max_bins=16, shard_rows=64
+    )
+    with record_fits() as rec:
+        telem = FitTelemetry.start(family="test", n=store.n)
+        with ShardPrefetcher(store, depth=1, telem=telem,
+                             to_device=False) as pf:
+            for _ in pf.sweep():
+                pass
+        telem.finish()
+    spans = _spans(rec.events)
+    loads = _spans(spans, "shard_load")
+    waits = _spans(spans, "shard_wait")
+    assert len(loads) == store.num_shards
+    assert len(waits) == store.num_shards
+    root = _spans(spans, "fit")[0]
+    for s in loads:
+        assert s["thread"] == "se-tpu-shard"  # the worker's own track
+        assert s["parent_id"] == root["span_id"]
+        assert s["bytes"] > 0
+    # a prefetch miss is a causal edge: the wait's flow_in must point at
+    # the load that was still running (shard 0 is always a cold miss)
+    misses = [s for s in waits if not s["hit"]]
+    assert misses
+    sources = {
+        fid for s in loads for fid in (s.get("flow_out") or [])
+    }
+    for s in misses:
+        assert s["flow_in"] in sources
+    assert all(s.get("flow_in") is None for s in waits if s["hit"])
+    assert viewer.validate(spans) == []
+
+
+# ---------------------------------------------------------------------------
+# fleet: request/serve spans, statusz
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_request_spans_and_statusz(fitted):
+    X, y, model = fitted
+    with record_fits() as rec:
+        router = FleetRouter(
+            model, replicas=2, min_bucket=8, max_batch_size=16,
+            deadline_ms=30_000.0,
+        )
+        try:
+            for _ in range(6):
+                router.predict(X[:4])
+            z = router.statusz()
+            # the router doubles as a live global_metrics() source while
+            # it runs (docs/tracing.md); the key dies with stop()
+            from spark_ensemble_tpu.telemetry import global_metrics
+
+            key = f"fleet/{z['stream']}"
+            live = global_metrics().snapshot()[key]
+            assert live["type"] == "source"
+            assert live["value"]["requests"] == 6
+        finally:
+            router.stop()
+        assert key not in global_metrics().snapshot()
+    assert z["requests"] == 6 and not z["stopped"]
+    assert z["trace_id"] == router._tracer.trace_id
+    assert z["model"] == {"num_members": ROUNDS, "num_features": X.shape[1]}
+    assert set(z["replicas"]) == {"fleet:r0", "fleet:r1"}
+    assert 0.0 <= z["hedge_rate"] <= 1.0
+    assert z["counters"]["hedges_fired"] == 0
+    zstop = router.statusz()
+    assert zstop["stopped"] and zstop["requests"] == 6
+    spans = _spans(rec.events)
+    reqs = _spans(spans, "fleet_request")
+    serves = _spans(spans, "serve")
+    assert len(reqs) == 6 and len(serves) == 6
+    for s in serves:
+        assert s["parent_id"] in {r["span_id"] for r in reqs}
+        assert s["thread"] in ("fleet:r0", "fleet:r1")
+        assert s["delivered"]
+    for r in reqs:
+        assert r["trace_id"] == z["trace_id"]
+        assert r["replica"] in ("fleet:r0", "fleet:r1")
+        assert not r["hedged"]
+    assert viewer.validate(spans) == []
+
+
+# ---------------------------------------------------------------------------
+# the ISSUE acceptance: chaos run -> validated Perfetto export with a
+# hedge flow arrow and an invalidated speculative chunk
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_trace_exports_hedge_and_invalidation_flows(fitted, tmp_path):
+    X, y, model = fitted
+    jsonl = str(tmp_path / "telemetry.jsonl")
+
+    # leg 1: a stalled replica forces a hedge (tests/test_fleet.py's
+    # deterministic idiom), spans landing in the JSONL sink
+    install(ChaosController(seed=7, rate=1.0, faults=("replica_stall",)))
+    router = FleetRouter(
+        model, replicas=2, min_bucket=8, max_batch_size=16,
+        deadline_ms=30_000.0, hedge_init_ms=10.0, telemetry_path=jsonl,
+    )
+    try:
+        resp = router.predict(X[:4])
+        assert resp.hedged
+    finally:
+        router.stop()
+        install(ChaosController(seed=0, rate=0.0))
+
+    # leg 2: a speculative round-loop invalidation, through the SAME
+    # executor machinery the fits use, appended to the SAME stream
+    def _sink(rec):
+        rec = dict(rec)
+        emit_event(rec.pop("event"), path=jsonl, **rec)
+
+    RoundExecutor(_ScriptedAdapter(
+        Tracer(_sink, thread="fit"), total=4, depth=2, invalidate_at=(0,),
+    )).run()
+
+    out = str(tmp_path / "trace.json")
+    summary = viewer.export(jsonl, out)  # raises on any unresolved edge
+    assert summary["spans"] >= 5 and summary["flows"] >= 2
+    spans = viewer.select_spans(viewer.load_events(jsonl))
+    assert viewer.validate(spans) == []
+
+    # hedge flow: the request span's flow_out feeds the twin serve on the
+    # OTHER replica
+    req = next(
+        s for s in _spans(spans, "fleet_request") if s.get("hedged")
+    )
+    assert len(req["flow_out"]) == 1
+    (hedge_flow,) = req["flow_out"]
+    serves = [
+        s for s in _spans(spans, "serve")
+        if s["parent_id"] == req["span_id"]
+    ]
+    assert len(serves) == 2  # primary + hedge twin
+    twin = next(s for s in serves if s.get("flow_in") == hedge_flow)
+    primary = next(s for s in serves if s.get("flow_in") is None)
+    assert twin["replica"] != primary["replica"]
+
+    # invalidation flow: the committing chunk's flow_out feeds every
+    # speculative chunk it killed
+    chunks = _spans(spans, "round_chunk")
+    killer = next(
+        s for s in chunks
+        if s["fate"] == "committed" and s.get("flow_out")
+    )
+    invalidated = [s for s in chunks if s["fate"] == "invalidated"]
+    assert len(invalidated) == 2
+    assert all(s["flow_in"] == killer["flow_out"][0] for s in invalidated)
+
+    # and the same structure must survive in the EXPORTED Perfetto JSON:
+    # flow arrows as "s"/"f" pairs, one named track per thread/replica
+    with open(out) as fh:
+        trace = json.load(fh)["traceEvents"]
+    by_ph = Counter(e["ph"] for e in trace)
+    assert by_ph["X"] == len(spans)
+    flow_ids = {hedge_flow, killer["flow_out"][0]}
+    for fid in flow_ids:
+        starts = [e for e in trace if e["ph"] == "s" and e["id"] == fid]
+        finishes = [e for e in trace if e["ph"] == "f" and e["id"] == fid]
+        assert len(starts) == 1
+        assert finishes and all(e["bp"] == "e" for e in finishes)
+        # the arrow renders forward in time
+        assert all(e["ts"] >= starts[0]["ts"] for e in finishes)
+    tracks = {
+        e["args"]["name"] for e in trace
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert {"fleet:r0", "fleet:r1", "fit", "router"} <= tracks
+    # the chaos run's hedge_fired instant rides along as a marker
+    assert any(
+        e["ph"] == "i" and e["name"] == "hedge_fired" for e in trace
+    )
+
+
+# ---------------------------------------------------------------------------
+# trace_viewer unit coverage: validation failures + CLI
+# ---------------------------------------------------------------------------
+
+
+def _span(name, span_id, parent_id="", **kw):
+    rec = {
+        "event": "span", "name": name, "trace_id": "t1", "span_id": span_id,
+        "parent_id": parent_id, "ts": 10.0, "dur_s": 0.5, "pid": 1,
+    }
+    rec.update(kw)
+    return rec
+
+
+def test_validate_flags_orphans_and_dangling_flows():
+    clean = [
+        _span("fit", "a"),
+        _span("round_chunk", "b", "a", flow_out=[9]),
+        _span("round_chunk", "c", "a", flow_in=9),
+    ]
+    assert viewer.validate(clean) == []
+    problems = viewer.validate([
+        _span("round_chunk", "b", "missing"),
+        _span("serve", "c", flow_in=42),
+    ])
+    assert len(problems) == 2
+    assert any("orphan" in p for p in problems)
+    assert any("no flow_out source" in p for p in problems)
+
+
+def test_export_raises_on_unresolved_graph(tmp_path):
+    path = tmp_path / "bad.jsonl"
+    path.write_text(json.dumps(_span("x", "a", "missing")) + "\n")
+    with pytest.raises(ValueError, match="unresolved"):
+        viewer.export(str(path))
+
+
+def test_viewer_cli_roundtrip(tmp_path, capsys):
+    path = tmp_path / "t.jsonl"
+    rows = [
+        _span("fit", "a", thread="fit"),
+        _span("serve", "b", "a", thread="r0"),
+        {"event": "hedge_fired", "ts": 10.2, "seq": 0, "fit_id": "s"},
+        {"event": "round_end", "round": 0},  # non-span rows pass through
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    out = tmp_path / "trace.json"
+    assert viewer.main(["--jsonl", str(path), "--out", str(out)]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["spans"] == 2 and summary["instants"] == 1
+    trace = json.loads(out.read_text())["traceEvents"]
+    names = {e["args"]["name"] for e in trace if e["ph"] == "M"}
+    assert names == {"fit", "r0", "main"}  # the instant's default track
+    xs = [e for e in trace if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"fit", "serve"}
+    assert all(e["dur"] >= 1.0 for e in xs)  # sub-µs spans stay visible
+    assert viewer.main(["--jsonl", str(path), "--validate"]) == 0
+
+    orphan = tmp_path / "orphan.jsonl"
+    orphan.write_text(json.dumps(_span("x", "z", "missing")) + "\n")
+    assert viewer.main(["--jsonl", str(orphan), "--validate"]) == 1
+    assert viewer.main(["--jsonl", str(orphan), "--out", str(out)]) == 1
+
+
+def test_viewer_trace_id_filter(tmp_path):
+    path = tmp_path / "t.jsonl"
+    rows = [
+        _span("fit", "a"),
+        dict(_span("fit", "b"), trace_id="t2"),
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    summary = viewer.export(str(path), trace_id="t2")
+    assert summary["spans"] == 1 and summary["traces"] == ["t2"]

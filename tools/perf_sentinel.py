@@ -1,0 +1,180 @@
+"""Perf-regression sentinel: diff the newest bench record against a
+committed baseline and fail CI on regression.
+
+The driver commits one ``BENCH_r*.json`` per round; its ``parsed``
+payload is ``bench.py``'s JSON line (headline fit metric, serving
+throughput, and — when the bench emits them — telemetry-derived shares
+like ``host_blocked_share`` / ``shard_wait_share`` /
+``serving_p99_ms`` / ``compiles_since_warmup`` /
+``trace_overhead_pct``).  This tool compares the newest record against
+``PERF_BASELINE.json`` with a per-metric noise floor and direction, so a
+real regression fails loudly while runner jitter does not:
+
+    python tools/perf_sentinel.py                # repo-root defaults
+    python tools/perf_sentinel.py --bench BENCH_r05.json \
+        --baseline PERF_BASELINE.json
+    python tools/perf_sentinel.py --update-baseline   # escape hatch
+
+Rules (docs/tracing.md#perf-sentinel):
+
+- A metric present in the baseline but missing from the bench record is
+  SKIPPED with a note (bench payloads are headline-only on some
+  platforms), never a failure — absence is not a regression.
+- ``platform`` must match; comparing a CPU-fallback run against a TPU
+  baseline (or vice versa) is skipped entirely with exit 0 and a
+  ``platform_mismatch`` note, because every number would be noise.
+- ``--update-baseline`` rewrites ``PERF_BASELINE.json`` from the newest
+  bench record.  CI runs WITHOUT it; a deliberate perf change lands by
+  running it locally and committing the new baseline in the same PR.
+
+Exit codes: 0 = no regression (or nothing comparable), 1 = regression.
+stdlib-only so the CI job needs no jax install.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_DEFAULT = os.path.join(REPO, "PERF_BASELINE.json")
+
+#: metric -> (direction, relative noise floor, absolute noise floor).
+#: direction "higher" means bigger is better (throughput); "lower" means
+#: smaller is better (latency, shares, counts).  A candidate only fails
+#: when it is worse by MORE than both floors.
+METRICS: Dict[str, Any] = {
+    "value":                 ("higher", 0.10, 0.0),   # headline iters/sec
+    "fit_seconds":           ("lower", 0.15, 0.5),
+    "predict_rows_per_sec":  ("higher", 0.15, 0.0),
+    "serving_p99_ms":        ("lower", 0.25, 1.0),
+    "host_blocked_share":    ("lower", 0.25, 0.02),
+    "shard_wait_share":      ("lower", 0.25, 0.02),
+    "compiles_since_warmup": ("lower", 0.0, 0.0),     # zero-compile contract
+    "trace_overhead_pct":    ("lower", 0.50, 1.0),    # disabled-path <1%
+}
+
+
+def load_bench(path: str) -> Dict[str, Any]:
+    """A bench payload: either ``bench.py``'s raw JSON line or the
+    driver's ``{"parsed": ...}`` wrapper around it."""
+    with open(path) as fh:
+        rec = json.load(fh)
+    if isinstance(rec.get("parsed"), dict):
+        rec = rec["parsed"]
+    return rec
+
+
+def newest_bench(repo: str = REPO) -> Optional[str]:
+    """The newest ``BENCH_r*.json`` by round number (name sort — the
+    driver zero-pads round indices)."""
+    paths = sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")))
+    return paths[-1] if paths else None
+
+
+def compare(
+    baseline: Dict[str, Any], bench: Dict[str, Any]
+) -> Dict[str, List[Dict[str, Any]]]:
+    """Per-metric verdicts: ``regressions`` / ``ok`` / ``skipped``."""
+    out: Dict[str, List[Dict[str, Any]]] = {
+        "regressions": [], "ok": [], "skipped": [],
+    }
+    bp = baseline.get("platform")
+    cp = bench.get("platform")
+    if bp and cp and bp != cp:
+        out["skipped"].append({
+            "metric": "*", "note":
+            f"platform_mismatch: baseline={bp} bench={cp}; nothing "
+            "comparable (commit a baseline from this platform)",
+        })
+        return out
+    for name, (direction, rel, floor) in METRICS.items():
+        if name not in baseline:
+            continue  # the baseline does not pin this metric
+        base = baseline[name]
+        if name not in bench or not isinstance(
+            bench.get(name), (int, float)
+        ):
+            out["skipped"].append({
+                "metric": name, "note":
+                "absent from bench record (headline-only payload)",
+            })
+            continue
+        cur = float(bench[name])
+        base = float(base)
+        if direction == "higher":
+            delta = base - cur          # positive == worse
+            allowed = max(abs(base) * rel, floor)
+        else:
+            delta = cur - base
+            allowed = max(abs(base) * rel, floor)
+        row = {
+            "metric": name, "baseline": base, "bench": cur,
+            "direction": direction, "allowed": allowed,
+            "worse_by": delta,
+        }
+        (out["regressions"] if delta > allowed else out["ok"]).append(row)
+    return out
+
+
+def update_baseline(
+    bench: Dict[str, Any], path: str = BASELINE_DEFAULT
+) -> Dict[str, Any]:
+    """Rewrite the committed baseline from a bench payload: only the
+    metrics the sentinel compares, plus the platform tag."""
+    base = {
+        k: bench[k] for k in METRICS
+        if isinstance(bench.get(k), (int, float))
+    }
+    if bench.get("platform"):
+        base["platform"] = bench["platform"]
+    base["source"] = bench.get("device", "")
+    with open(path, "w") as fh:
+        json.dump(base, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return base
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--bench", default=None,
+                        help="bench record (default: newest BENCH_r*.json)")
+    parser.add_argument("--baseline", default=BASELINE_DEFAULT)
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from the bench record "
+                        "instead of comparing (commit the result)")
+    args = parser.parse_args(argv)
+    bench_path = args.bench or newest_bench()
+    if bench_path is None:
+        print(json.dumps({"skipped": "no BENCH_r*.json found"}))
+        return 0
+    bench = load_bench(bench_path)
+    if args.update_baseline:
+        base = update_baseline(bench, args.baseline)
+        print(json.dumps({"updated": args.baseline, "baseline": base}))
+        return 0
+    if not os.path.exists(args.baseline):
+        print(json.dumps({
+            "skipped": f"{args.baseline} missing; run --update-baseline",
+        }))
+        return 0
+    with open(args.baseline) as fh:
+        baseline = json.load(fh)
+    verdict = compare(baseline, bench)
+    print(json.dumps({
+        "bench": os.path.basename(bench_path),
+        "baseline": os.path.basename(args.baseline),
+        **verdict,
+    }, indent=2))
+    if verdict["regressions"]:
+        names = ", ".join(r["metric"] for r in verdict["regressions"])
+        print(f"PERF REGRESSION: {names} (see rows above; a deliberate "
+              "change lands via --update-baseline)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -209,11 +209,20 @@ def cmd_fleet(args):
     assert np.allclose(resp.value, tier_pred[:16], rtol=1e-5, atol=1e-6)
 
     snap = router.slo_snapshot()
+    # live statusz (docs/tracing.md): the operator view must resolve
+    # while the fleet is still up — per-replica state machines, queue
+    # depth, rolling percentiles, hedge rate
+    statusz = router.statusz()
+    assert set(statusz["replicas"]) == set(snap["replicas"])
+    assert statusz["requests"] == snap["requests"]
+    assert 0.0 <= statusz["hedge_rate"] <= 1.0
+    assert statusz["trace_id"]
     router.stop()  # emits the fleet_slo rows to --telemetry
     assert failed[0] == 0, f"{failed[0]} requests failed under faults"
     assert snap["compiles_since_warmup"] == 0, snap
     assert snap["crashes"] >= 1  # the deterministic kill, at minimum
     print(json.dumps({
+        "statusz": statusz,
         "requests": snap["requests"],
         "failed": failed[0],
         "crashes": snap["crashes"],

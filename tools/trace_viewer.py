@@ -1,0 +1,227 @@
+"""Export span telemetry to Chrome/Perfetto ``trace_event`` JSON.
+
+The tracing plane (spark_ensemble_tpu/telemetry/trace.py; docs/tracing.md)
+emits every unit of work as a ``"event": "span"`` row in the ordinary
+telemetry JSONL stream.  This tool turns one of those streams into a
+trace Perfetto (https://ui.perfetto.dev) or ``chrome://tracing`` can open:
+
+- one track per ``(pid, thread)`` — the fit thread, the shard-prefetch
+  worker, the checkpoint writer, the fleet router and each replica get
+  their own named rows;
+- one "X" (complete) slice per span, with the span's attributes as args;
+- flow arrows ("s"/"f" pairs) for every causal edge the span stream
+  records: hedge and replay dispatches, prefetch-miss waits, and commits
+  invalidating speculative round chunks;
+- instant markers for ``hedge_fired`` / ``replica_state`` /
+  ``request_shed`` events so breaker transitions line up with the slices.
+
+Usage:
+
+    python tools/trace_viewer.py --jsonl telemetry.jsonl --out trace.json
+    python tools/trace_viewer.py --jsonl telemetry.jsonl --validate
+
+``--validate`` (also run implicitly before export) checks the span graph:
+every non-empty ``parent_id`` must resolve to an emitted span and every
+``flow_in`` must have a matching ``flow_out`` source.  Exit code 1 on any
+unresolved edge — the CI serving-chaos and streaming jobs gate on it.
+stdlib-only: runs anywhere the JSONL landed, no jax required.
+"""
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+#: standalone event types rendered as instant markers on their track
+INSTANT_EVENTS = ("hedge_fired", "replica_state", "request_shed")
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # half-written tail line: the stream is append-only
+    return out
+
+
+def select_spans(
+    events: List[Dict[str, Any]], trace_id: Optional[str] = None
+) -> List[Dict[str, Any]]:
+    spans = [e for e in events if e.get("event") == "span"]
+    if trace_id:
+        spans = [s for s in spans if s.get("trace_id") == trace_id]
+    return spans
+
+
+def validate(spans: List[Dict[str, Any]]) -> List[str]:
+    """Structural problems in a span set (empty list == clean graph):
+    unresolved parents (orphan spans) and flow sinks with no source."""
+    problems: List[str] = []
+    ids = {s.get("span_id") for s in spans}
+    sources = set()
+    for s in spans:
+        for fid in s.get("flow_out") or []:
+            sources.add(fid)
+    for s in spans:
+        pid = s.get("parent_id") or ""
+        if pid and pid not in ids:
+            problems.append(
+                f"orphan span {s.get('span_id')} ({s.get('name')}): "
+                f"parent {pid} was never emitted"
+            )
+        fin = s.get("flow_in")
+        if fin is not None and fin not in sources:
+            problems.append(
+                f"span {s.get('span_id')} ({s.get('name')}): flow_in "
+                f"{fin} has no flow_out source"
+            )
+    return problems
+
+
+#: span-record keys that are structure, not user attributes
+_STRUCT_KEYS = (
+    "event", "name", "trace_id", "span_id", "parent_id", "ts", "dur_s",
+    "pid", "thread", "flow_in", "flow_out", "fit_id", "wall_time",
+)
+
+
+def _tid_for(
+    pid: int, thread: Optional[str],
+    tids: Dict[Tuple[int, str], int], meta: List[Dict[str, Any]],
+) -> int:
+    key = (pid, thread or "main")
+    if key not in tids:
+        # tid 0 reads as the process row in some UIs; start at 1
+        tids[key] = len(tids) + 1
+        meta.append({
+            "ph": "M", "name": "thread_name", "pid": pid,
+            "tid": tids[key], "args": {"name": key[1]},
+        })
+    return tids[key]
+
+
+def to_trace_events(
+    spans: List[Dict[str, Any]],
+    instants: Optional[List[Dict[str, Any]]] = None,
+) -> Dict[str, Any]:
+    """Chrome ``trace_event`` JSON (object form) for a span set."""
+    tids: Dict[Tuple[int, str], int] = {}
+    meta: List[Dict[str, Any]] = []
+    out: List[Dict[str, Any]] = []
+    for s in spans:
+        pid = int(s.get("pid", 0))
+        tid = _tid_for(pid, s.get("thread"), tids, meta)
+        ts_us = float(s.get("ts", 0.0)) * 1e6
+        dur_us = max(float(s.get("dur_s", 0.0)) * 1e6, 1.0)
+        args = {k: v for k, v in s.items() if k not in _STRUCT_KEYS}
+        args["span_id"] = s.get("span_id")
+        if s.get("parent_id"):
+            args["parent_id"] = s["parent_id"]
+        out.append({
+            "ph": "X", "name": s.get("name", "?"),
+            "cat": s.get("trace_id", "trace"),
+            "ts": ts_us, "dur": dur_us, "pid": pid, "tid": tid,
+            "args": args,
+        })
+        # flow arrows: "s" anchored inside the source slice, "f" (bp "e")
+        # inside the sink slice — source slices always start no later
+        # than their sinks (a hedge's request span predates the twin
+        # serve; a committed chunk predates the speculative tail it
+        # invalidates), so the arrow renders forward in time
+        for fid in s.get("flow_out") or []:
+            out.append({
+                "ph": "s", "id": int(fid), "name": "flow", "cat": "flow",
+                "ts": ts_us, "pid": pid, "tid": tid,
+            })
+        fin = s.get("flow_in")
+        if fin is not None:
+            out.append({
+                "ph": "f", "bp": "e", "id": int(fin), "name": "flow",
+                "cat": "flow", "ts": ts_us + 1.0, "pid": pid, "tid": tid,
+            })
+    for e in instants or []:
+        pid = int(e.get("pid", 0))
+        tid = _tid_for(pid, e.get("thread"), tids, meta)
+        args = {
+            k: v for k, v in e.items()
+            if k not in ("event", "ts", "pid", "thread", "wall_time")
+        }
+        out.append({
+            "ph": "i", "s": "t", "name": e.get("event", "?"),
+            "cat": "marker",
+            "ts": float(e.get("ts", e.get("wall_time", 0.0))) * 1e6,
+            "pid": pid, "tid": tid, "args": args,
+        })
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
+def export(
+    jsonl_path: str,
+    out_path: Optional[str] = None,
+    trace_id: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Load + validate + convert; returns a summary dict (the CLI prints
+    it).  Raises ``ValueError`` on an unresolved span graph."""
+    events = load_events(jsonl_path)
+    spans = select_spans(events, trace_id=trace_id)
+    problems = validate(spans)
+    if problems:
+        raise ValueError(
+            f"{len(problems)} unresolved span edges:\n  "
+            + "\n  ".join(problems)
+        )
+    # standalone events already carry a wall-clock "ts" (emit_event)
+    instants = [e for e in events if e.get("event") in INSTANT_EVENTS]
+    trace = to_trace_events(spans, instants)
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(trace, fh)
+    tracks = {
+        (s.get("pid"), s.get("thread") or "main") for s in spans
+    }
+    flows = sum(len(s.get("flow_out") or []) for s in spans)
+    return {
+        "spans": len(spans),
+        "tracks": len(tracks),
+        "flows": flows,
+        "instants": len(instants),
+        "traces": sorted({s.get("trace_id", "") for s in spans}),
+        "out": out_path,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--jsonl", required=True,
+                        help="telemetry JSONL stream to read")
+    parser.add_argument("--out", default=None,
+                        help="write Perfetto trace_event JSON here")
+    parser.add_argument("--trace", default=None,
+                        help="only export this trace_id")
+    parser.add_argument("--validate", action="store_true",
+                        help="only check the span graph; no export")
+    args = parser.parse_args(argv)
+    if args.validate and not args.out:
+        spans = select_spans(load_events(args.jsonl), trace_id=args.trace)
+        problems = validate(spans)
+        for p in problems:
+            print(f"UNRESOLVED: {p}", file=sys.stderr)
+        print(json.dumps({"spans": len(spans), "problems": len(problems)}))
+        return 1 if problems else 0
+    try:
+        summary = export(args.jsonl, args.out, trace_id=args.trace)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
