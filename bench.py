@@ -1166,6 +1166,60 @@ def inner():
     if "shard_wait_share_of_wall" in streaming:
         out["shard_wait_share"] = streaming["shard_wait_share_of_wall"]
 
+    # pod-scale leg (parallel/elastic.py): the SAME streaming fit with the
+    # row mesh spread over every device — each position sweeps only its
+    # manifest slice and the per-level histograms cross the mesh through
+    # the ordered reduce.  Reported: training rows/sec through the
+    # distributed plane and the reduce's share of sweep wall
+    # (dcn_reduce_share: the fraction an actual DCN hop would own —
+    # measured under SE_TPU_DIST_MEASURE fences, so the sweep itself is
+    # serialized and rows/sec here is a floor, not a peak).
+    multihost = {}
+    try:
+        if len(jax.devices()) < 2:
+            multihost = {"note": "single device; distributed leg moot"}
+        elif "fit_seconds" not in streaming:
+            multihost = {"note": "streaming leg unavailable; skipped"}
+        else:
+            from spark_ensemble_tpu.parallel import elastic as _elastic
+            from spark_ensemble_tpu.parallel.mesh import data_member_mesh
+
+            mh_w = 4 if len(jax.devices()) >= 4 else 2
+            mh_mesh = data_member_mesh(mh_w, member=1)
+            mh_est = st_est.copy()
+            os.environ["SE_TPU_DIST_MEASURE"] = "1"
+            try:
+                _block_on_model(
+                    mh_est.copy().fit_streaming(store, ys, mesh=mh_mesh)
+                )  # warmup
+                t0 = time.perf_counter()
+                _block_on_model(mh_est.fit_streaming(store, ys, mesh=mh_mesh))
+                mh_s = time.perf_counter() - t0
+            finally:
+                os.environ.pop("SE_TPU_DIST_MEASURE", None)
+            mh_stats = _elastic.last_fit_stats()
+            multihost = {
+                "positions": mh_w,
+                "rows": st_rows_cap,
+                "rounds": st_rounds,
+                "shards": store.num_shards,
+                "fit_seconds": round(mh_s, 3),
+                "rows_per_sec": round(st_rows_cap * st_rounds / mh_s, 1),
+                "sweep_seconds": round(mh_stats.get("sweep_s", 0.0), 3),
+                "reduce_seconds": round(mh_stats.get("reduce_s", 0.0), 3),
+                "dcn_reduce_share": round(
+                    mh_stats.get("reduce_s", 0.0)
+                    / max(mh_stats.get("sweep_s", 0.0), 1e-9),
+                    4,
+                ),
+            }
+    except Exception as e:  # noqa: BLE001 - carry, keep going
+        multihost = {"error": str(e)[:200]}
+    out["multihost"] = multihost
+    if "rows_per_sec" in multihost:
+        out["multihost_rows_per_sec"] = multihost["rows_per_sec"]
+        out["dcn_reduce_share"] = multihost["dcn_reduce_share"]
+
     extras = {}
     if os.environ.get("BENCH_FULL") == "1":
         extras = _bench_full_extras()

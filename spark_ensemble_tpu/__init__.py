@@ -145,9 +145,21 @@ from spark_ensemble_tpu.execution import (
 )
 from spark_ensemble_tpu import data
 from spark_ensemble_tpu.data import (
+    PartitionedShardReader,
+    ShardPartition,
     ShardPrefetcher,
     ShardStore,
+    manifest_digest,
+    partition_shards,
     write_shards,
+)
+from spark_ensemble_tpu import parallel
+from spark_ensemble_tpu.parallel import (
+    DistributedSweep,
+    ElasticCoordinator,
+    HostLostError,
+    slice_count,
+    survivor_mesh,
 )
 from spark_ensemble_tpu.models.base import shared_fit_context
 from spark_ensemble_tpu.utils.persist import load
@@ -240,6 +252,15 @@ __all__ = [
     "ShardStore",
     "ShardPrefetcher",
     "write_shards",
+    "PartitionedShardReader",
+    "ShardPartition",
+    "manifest_digest",
+    "partition_shards",
+    "DistributedSweep",
+    "ElasticCoordinator",
+    "HostLostError",
+    "slice_count",
+    "survivor_mesh",
     "shared_fit_context",
     "lint_paths",
     "ContractReport",

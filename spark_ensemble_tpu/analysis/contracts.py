@@ -626,6 +626,81 @@ def _trace_streaming(report: ContractReport) -> None:
             )
 
 
+def _trace_streaming_dist(report: ContractReport) -> None:
+    """Trace the pod-scale distributed streaming fit (parallel/elastic.py).
+
+    The elastic plane's budget contract extends the streaming one across
+    the mesh: a distributed-histogram fit dispatches a FIXED set of
+    cached programs regardless of BOTH the shard count and the row-mesh
+    width — each host's sweep walks its manifest slice through one
+    step-indexed program set, and the cross-host reduce is one program
+    per accumulator rank.  Traced at two mesh widths x two shard counts;
+    any variation is a ``distributed`` violation (a per-host or
+    per-shard retrace would stall every host behind the compiler at pod
+    scale)."""
+    import tempfile
+
+    import jax
+
+    from spark_ensemble_tpu.data import write_shards
+    from spark_ensemble_tpu.models.base import observe_program_calls
+    from spark_ensemble_tpu.parallel.mesh import data_member_mesh
+
+    import spark_ensemble_tpu as se
+
+    entry = "gbm_regressor.fit_streaming_dist"
+    if len(jax.devices()) < 4:
+        report.skipped[entry] = (
+            "distributed trace needs >= 4 devices (canonical CI env "
+            "forces 8 virtual CPU devices)"
+        )
+        return
+    X, y = _canonical_data(False)
+    counts: Dict[Tuple[int, int], int] = {}
+    for width in (2, 4):
+        mesh = data_member_mesh(width, member=1)
+        for shard_rows in (32, 16):  # _N=64 rows -> 2 shards, then 4
+            with tempfile.TemporaryDirectory(
+                prefix="graftlint-dist-shards-"
+            ) as tmp:
+                store = write_shards(
+                    X,
+                    os.path.join(tmp, "store"),
+                    max_bins=64,
+                    shard_rows=shard_rows,
+                )
+                est = se.GBMRegressor(
+                    base_learner=se.DecisionTreeRegressor(max_depth=3),
+                    num_base_learners=3,
+                    seed=0,
+                )
+                rec = _ProgramRecorder()
+                try:
+                    with observe_program_calls(rec):
+                        est.fit_streaming(store, y, mesh=mesh)
+                except Exception as e:  # noqa: BLE001
+                    report.skipped[entry] = (
+                        f"distributed streaming fit not traceable: {e!r:.120}"
+                    )
+                    return
+                counts[(width, store.num_shards)] = rec.count()
+                for (tag, _), jaxpr in rec.programs.items():
+                    if jaxpr is not None:
+                        _check_jaxpr(entry, tag, jaxpr, report.violations)
+    report.budgets[entry] = counts[(2, 2)]
+    if len(set(counts.values())) != 1:
+        report.violations.append(
+            ContractViolation(
+                "distributed",
+                entry,
+                "program count varies with (mesh width, shard count): "
+                f"{ {f'{w}x{s}': c for (w, s), c in sorted(counts.items())} }"
+                "; the distributed sweep must reuse one compiled program "
+                "set per level across hosts and steps",
+            )
+        )
+
+
 def _trace_tracing(report: ContractReport) -> None:
     """Trace the causal-tracing plane's own budget (telemetry/trace.py).
 
@@ -684,6 +759,8 @@ def trace_contracts(
             _trace_fleet(report)
         if wanted is None or "streaming" in wanted:
             _trace_streaming(report)
+        if wanted is None or "distributed" in wanted:
+            _trace_streaming_dist(report)
         if wanted is None or "tracing" in wanted:
             _trace_tracing(report)
     return report

@@ -9,6 +9,12 @@ packed matrix on device — bit-identically to a resident
 ``hist="stream"`` fit.
 """
 
+from spark_ensemble_tpu.data.partition import (
+    PartitionedShardReader,
+    ShardPartition,
+    manifest_digest,
+    partition_shards,
+)
 from spark_ensemble_tpu.data.prefetch import (
     DEFAULT_PREFETCH_DEPTH,
     ShardLoadError,
@@ -24,9 +30,13 @@ from spark_ensemble_tpu.data.shards import (
 __all__ = [
     "DEFAULT_PREFETCH_DEPTH",
     "DEFAULT_SHARD_ROWS",
+    "PartitionedShardReader",
     "SHARD_FORMAT",
     "ShardLoadError",
+    "ShardPartition",
     "ShardPrefetcher",
     "ShardStore",
+    "manifest_digest",
+    "partition_shards",
     "write_shards",
 ]

@@ -29,7 +29,7 @@ import json
 import os
 import shutil
 import tempfile
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
 import numpy as np
@@ -153,10 +153,14 @@ class ShardStore:
     """
 
     def __init__(self, directory: str, manifest: Dict[str, Any],
-                 thresholds: np.ndarray):
+                 thresholds: np.ndarray,
+                 verified_shards: Optional[frozenset] = None):
         self.directory = directory
         self._manifest = manifest
         self._thresholds = thresholds
+        #: None = every shard verified (full open); otherwise the subset
+        #: whose bytes this host checked — reads outside it are refused
+        self._verified_shards = verified_shards
 
     # -- geometry ------------------------------------------------------
     @property
@@ -202,9 +206,31 @@ class ShardStore:
     def shard_meta(self, i: int) -> Dict[str, Any]:
         return self._manifest["shards"][i]
 
+    @property
+    def verified_shards(self) -> Optional[frozenset]:
+        """Shard indices whose bytes were hash-verified at ``open``;
+        ``None`` means all of them (a full open)."""
+        return self._verified_shards
+
     # -- IO ------------------------------------------------------------
     @classmethod
-    def open(cls, directory: str, verify: bool = True) -> "ShardStore":
+    def open(
+        cls,
+        directory: str,
+        verify: bool = True,
+        shards: Optional[Sequence[int]] = None,
+    ) -> "ShardStore":
+        """Open a sealed store, optionally verifying only ``shards``.
+
+        With ``shards=`` (a host opening its manifest partition), only
+        the named entries plus the thresholds file pay existence/size/
+        sha256 checks — a host never touches the bytes of other hosts'
+        slices.  The *manifest* is still checked in full: per-entry row
+        counts must tile ``n`` exactly and indices must be dense, so a
+        subset open cannot disagree with the global row count or bin
+        thresholds that every other host derives from the same manifest.
+        Reads outside the verified subset raise.
+        """
         directory = os.path.abspath(directory)
         mpath = os.path.join(directory, _MANIFEST)
         if not os.path.exists(mpath):
@@ -217,7 +243,43 @@ class ShardStore:
                 f"shard store format {fmt} unsupported "
                 f"(expected {SHARD_FORMAT}); re-run write_shards"
             )
-        entries = list(manifest["shards"]) + [manifest["thresholds"]]
+        all_shards = manifest["shards"]
+        num_shards = len(all_shards)
+        # manifest-internal geometry: the global n every host agrees on
+        # must equal the sum of per-shard rows, laid out densely
+        rows_total = 0
+        for pos, ent in enumerate(all_shards):
+            if int(ent["index"]) != pos:
+                raise ValueError(
+                    f"shard manifest entry {pos} has index {ent['index']} "
+                    "— manifest is not dense; refusing to partition it"
+                )
+            if not 1 <= int(ent["rows"]) <= int(manifest["shard_rows"]):
+                raise ValueError(
+                    f"shard {pos} claims {ent['rows']} rows, outside "
+                    f"[1, {manifest['shard_rows']}]"
+                )
+            rows_total += int(ent["rows"])
+        if rows_total != int(manifest["n"]):
+            raise ValueError(
+                f"shard rows sum to {rows_total} but manifest n is "
+                f"{manifest['n']} — global row count disagrees"
+            )
+        verified: Optional[frozenset] = None
+        if shards is None:
+            entries = list(all_shards) + [manifest["thresholds"]]
+        else:
+            subset = [int(i) for i in shards]
+            if len(set(subset)) != len(subset):
+                raise ValueError(f"duplicate shard indices in subset: {subset}")
+            bad = [i for i in subset if not 0 <= i < num_shards]
+            if bad:
+                raise ValueError(
+                    f"shard subset {bad} out of range for a "
+                    f"{num_shards}-shard manifest"
+                )
+            entries = [all_shards[i] for i in subset] + [manifest["thresholds"]]
+            verified = frozenset(subset)
         for ent in entries:
             fpath = os.path.join(directory, ent["file"])
             if not os.path.exists(fpath):
@@ -235,7 +297,7 @@ class ShardStore:
                 )
         with np.load(os.path.join(directory, manifest["thresholds"]["file"])) as z:
             thresholds = np.asarray(z["thresholds"], np.float32)
-        return cls(directory, manifest, thresholds)
+        return cls(directory, manifest, thresholds, verified_shards=verified)
 
     def load_shard(self, i: int) -> np.ndarray:
         """Shard ``i``'s packed words, zero-padded to ``shard_rows``
@@ -243,6 +305,12 @@ class ShardStore:
         consumer pairs them with all-zero value channels, so the padding
         contributes exactly 0.0 to every statistic — same rule as the
         resident stream tier's row padding."""
+        if self._verified_shards is not None and i not in self._verified_shards:
+            raise ValueError(
+                f"shard {i} is outside this handle's verified subset "
+                f"(opened with shards={sorted(self._verified_shards)}); "
+                "re-open with the full manifest or a wider subset"
+            )
         ent = self._manifest["shards"][i]
         with np.load(os.path.join(self.directory, ent["file"])) as z:
             packed = np.asarray(z["packed"], np.uint32)

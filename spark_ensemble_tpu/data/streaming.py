@@ -200,11 +200,23 @@ def _leaf_finish_prog():
 
 
 def _sweep_forest(prefetch, ctl, site, vals_p, y_mean, mask, thresholds, *,
-                  max_depth, B, bits, d, prec, min_gain):
+                  max_depth, B, bits, d, prec, min_gain, dist=None):
     """Fit M trees over the shard store: ``max_depth + 1`` shard sweeps
     (one histogram sweep per level, one leaf sweep) -> ``(Tree [M, ...],
     node_all [S, R, M])``.  Mirrors ``_fit_forest_streamed`` exactly,
-    with the ``lax.scan`` replaced by the prefetched shard loop."""
+    with the ``lax.scan`` replaced by the prefetched shard loop.
+
+    With ``dist`` (a ``parallel/elastic.py`` ``DistributedSweep``), the
+    sweeps run mesh-wide instead — each row position folds only its
+    manifest slice and positions reduce before split selection — with
+    the same return contract and, under ``reduce="ordered"``,
+    bit-identical outputs."""
+    if dist is not None:
+        return dist.sweep_forest(
+            prefetch, ctl, site, vals_p, y_mean, mask, thresholds,
+            max_depth=max_depth, B=B, bits=bits, d=d, prec=prec,
+            min_gain=min_gain,
+        )
     S, R, M, C = vals_p.shape
     num_internal = 2 ** max_depth - 1
     sf = jnp.zeros((M, num_internal), jnp.int32)
@@ -347,11 +359,17 @@ def _emit_shard_io(telem, prefetch):
 
 
 def fit_streaming_regressor(est, store, y, sample_weight=None, X_val=None,
-                            y_val=None):
+                            y_val=None, mesh=None, reduce="ordered"):
     """Out-of-core ``GBMRegressor`` fit over a ``ShardStore`` — the
     streaming twin of ``GBMRegressor.fit`` (models/gbm.py), bit-identical
     to a resident ``hist="stream"`` fit with matched chunk rows.  The
-    validation split (if any) stays resident (raw features)."""
+    validation split (if any) stays resident (raw features).
+
+    With ``mesh``, the shard sweeps distribute over the mesh's row
+    positions (parallel/elastic.py): each position prefetches only its
+    round-robin manifest slice and contributions are reduced across
+    ``{dcn_data, data}`` before split selection — still bit-identical
+    under ``reduce="ordered"``, allclose under ``reduce="psum"``."""
     from spark_ensemble_tpu.models.gbm import (
         GBMRegressionModel,
         _pseudo_residuals_and_weights,
@@ -384,6 +402,12 @@ def fit_streaming_regressor(est, store, y, sample_weight=None, X_val=None,
         "streaming_config", shards=S, shard_rows=R, bits=bits,
         packed_bytes=store.packed_nbytes,
     )
+    dist = None
+    if mesh is not None:
+        from spark_ensemble_tpu.parallel.elastic import DistributedSweep
+
+        dist = DistributedSweep(mesh, store, reduce=reduce, telem=telem)
+        dist.check_agreement()
     bag_keys, masks = est._sampling_plan(n, d)
     bag_many = est._make_bag_many_fn(n, n)
     ctl = controller()
@@ -575,7 +599,12 @@ def fit_streaming_regressor(est, store, y, sample_weight=None, X_val=None,
             },
         )
 
-    prefetch = ShardPrefetcher(store, telem=telem)
+    # distributed: each host prefetches only its manifest slice, as raw
+    # numpy blocks (the sweep re-places them per mesh row position)
+    prefetch = ShardPrefetcher(
+        dist.reader() if dist is not None else store,
+        telem=telem, to_device=dist is None,
+    )
     try:
         def run_chunk(sl, step_scale=1.0):
             nonlocal pred, pred_val, delta
@@ -593,7 +622,7 @@ def fit_streaming_regressor(est, store, y, sample_weight=None, X_val=None,
                     prefetch, ctl, f"GBMRegressor:stream_round:{r}",
                     vals_p, y_mean, masks_c[j], thresholds,
                     max_depth=max_depth, B=B, bits=bits, d=d, prec=prec,
-                    min_gain=min_gain,
+                    min_gain=min_gain, dist=dist,
                 )
                 direction = dirp(node_all, forest.leaf_value)
                 # unbatch M=1 — the member layout the resident fit stores
@@ -632,6 +661,12 @@ def fit_streaming_regressor(est, store, y, sample_weight=None, X_val=None,
         )
     finally:
         prefetch.close()
+        if dist is not None:
+            from spark_ensemble_tpu.parallel.elastic import (
+                _record_fit_stats,
+            )
+
+            _record_fit_stats(dist)
     ckpt.delete()
 
     keep = i - v
@@ -665,11 +700,13 @@ def fit_streaming_regressor(est, store, y, sample_weight=None, X_val=None,
 
 
 def fit_streaming_classifier(est, store, y, sample_weight=None, X_val=None,
-                             y_val=None, num_classes=None):
+                             y_val=None, num_classes=None, mesh=None,
+                             reduce="ordered"):
     """Out-of-core ``GBMClassifier`` fit over a ``ShardStore`` — the
     streaming twin of ``GBMClassifier.fit`` (single-chip path; the class
     dims fold into the shard programs' M axis like the resident fused
-    forest)."""
+    forest).  ``mesh``/``reduce`` distribute the shard sweeps exactly as
+    in :func:`fit_streaming_regressor`."""
     from spark_ensemble_tpu.models.gbm import (
         GBMClassificationModel,
         _pseudo_residuals_and_weights,
@@ -700,6 +737,12 @@ def fit_streaming_classifier(est, store, y, sample_weight=None, X_val=None,
         "streaming_config", shards=S, shard_rows=R, bits=bits,
         packed_bytes=store.packed_nbytes,
     )
+    dist = None
+    if mesh is not None:
+        from spark_ensemble_tpu.parallel.elastic import DistributedSweep
+
+        dist = DistributedSweep(mesh, store, reduce=reduce, telem=telem)
+        dist.check_agreement()
     bag_keys, masks = est._sampling_plan(n, d)
     bag_many = est._make_bag_many_fn(n, n)
     ctl = controller()
@@ -880,7 +923,12 @@ def fit_streaming_classifier(est, store, y, sample_weight=None, X_val=None,
             },
         )
 
-    prefetch = ShardPrefetcher(store, telem=telem)
+    # distributed: each host prefetches only its manifest slice, as raw
+    # numpy blocks (the sweep re-places them per mesh row position)
+    prefetch = ShardPrefetcher(
+        dist.reader() if dist is not None else store,
+        telem=telem, to_device=dist is None,
+    )
     try:
         def run_chunk(sl, step_scale=1.0):
             nonlocal pred, pred_val, alpha_ws
@@ -898,7 +946,7 @@ def fit_streaming_classifier(est, store, y, sample_weight=None, X_val=None,
                     prefetch, ctl, f"GBMClassifier:stream_round:{r}",
                     vals_p, y_mean, masks_c[j], thresholds,
                     max_depth=max_depth, B=B, bits=bits, d=d, prec=prec,
-                    min_gain=min_gain,
+                    min_gain=min_gain, dist=dist,
                 )
                 directions = dirp(node_all, forest.leaf_value)
                 weight, pred, alpha_ws = upd(
@@ -937,6 +985,12 @@ def fit_streaming_classifier(est, store, y, sample_weight=None, X_val=None,
         )
     finally:
         prefetch.close()
+        if dist is not None:
+            from spark_ensemble_tpu.parallel.elastic import (
+                _record_fit_stats,
+            )
+
+            _record_fit_stats(dist)
     ckpt.delete()
 
     keep = i - v

@@ -1270,17 +1270,27 @@ class GBMRegressor(_GBMParams):
 
     @instrumented_fit
     def fit_streaming(self, store, y, sample_weight=None, X_val=None,
-                      y_val=None):
+                      y_val=None, mesh=None, reduce="ordered"):
         """Out-of-core fit over a sealed ``ShardStore`` (data/shards.py):
         the packed bin matrix streams from disk shard-by-shard, never
         resident on device at once — bit-identical to ``fit`` with a
         ``hist="stream"`` base learner at matched chunk rows (see
-        data/streaming.py for the argument)."""
+        data/streaming.py for the argument).
+
+        ``mesh`` distributes the shard sweeps across the mesh's row
+        positions (pod-scale training, parallel/elastic.py): each host
+        streams only its round-robin slice of the manifest and
+        histogram contributions reduce over ``{dcn_data, data}`` before
+        split selection.  ``reduce="ordered"`` (default) keeps the fit
+        bit-identical to the single-host one; ``reduce="psum"`` trades
+        that for cheaper cross-host traffic (allclose results).  Wrap
+        the call in an ``ElasticCoordinator`` to survive host
+        preemptions."""
         from spark_ensemble_tpu.data.streaming import fit_streaming_regressor
 
         return fit_streaming_regressor(
             self, store, y, sample_weight=sample_weight,
-            X_val=X_val, y_val=y_val,
+            X_val=X_val, y_val=y_val, mesh=mesh, reduce=reduce,
         )
 
 
@@ -1888,14 +1898,17 @@ class GBMClassifier(_GBMParams):
 
     @instrumented_fit
     def fit_streaming(self, store, y, sample_weight=None, X_val=None,
-                      y_val=None, num_classes=None):
+                      y_val=None, num_classes=None, mesh=None,
+                      reduce="ordered"):
         """Out-of-core fit over a sealed ``ShardStore`` (data/shards.py);
-        see ``GBMRegressor.fit_streaming``."""
+        see ``GBMRegressor.fit_streaming`` — including the ``mesh``/
+        ``reduce`` distributed-sweep knobs."""
         from spark_ensemble_tpu.data.streaming import fit_streaming_classifier
 
         return fit_streaming_classifier(
             self, store, y, sample_weight=sample_weight,
             X_val=X_val, y_val=y_val, num_classes=num_classes,
+            mesh=mesh, reduce=reduce,
         )
 
 

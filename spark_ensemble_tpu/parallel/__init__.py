@@ -1,13 +1,25 @@
 from spark_ensemble_tpu.parallel import multihost
+from spark_ensemble_tpu.parallel.elastic import (
+    DistributedSweep,
+    ElasticCoordinator,
+    HostLostError,
+    survivor_mesh,
+)
 from spark_ensemble_tpu.parallel.mesh import (
     create_mesh,
     data_member_mesh,
     hybrid_data_member_mesh,
 )
+from spark_ensemble_tpu.parallel.multihost import slice_count
 
 __all__ = [
+    "DistributedSweep",
+    "ElasticCoordinator",
+    "HostLostError",
     "create_mesh",
     "data_member_mesh",
     "hybrid_data_member_mesh",
     "multihost",
+    "slice_count",
+    "survivor_mesh",
 ]

@@ -1,0 +1,762 @@
+"""Pod-scale elastic training plane: multi-host sharded streaming fits.
+
+This module is the JAX-native replacement for the reference's
+``treeAggregate`` over cluster RDDs: the shard manifest is partitioned
+round-robin across the mesh's row positions (data/partition.py), every
+position sweeps only its slice, and per-position histogram
+contributions are reduced across the ``{dcn_data, data}`` axes before
+split selection.  Two reduction modes:
+
+- ``reduce="ordered"`` (default): one ``all_gather`` per sweep step,
+  folded position-by-position in a static unroll.  Because position
+  ``w`` holds shard ``k*W + w`` at step ``k``, the fold visits shard
+  contributions in exactly the global order ``0..S-1`` — the same f32
+  additions, in the same order, as the single-host shard sweep.  Each
+  contribution is computed as ``0 + D_s`` (a fresh zero accumulator),
+  which only normalizes ``-0`` to ``+0``; the running accumulator is
+  never ``-0`` (IEEE-754 round-to-nearest: ``x + y`` is ``-0`` only
+  when both are ``-0``), and ``x + (+0) == x + (-0) == x`` for every
+  ``x`` the fold can hold, so the distributed fit is BIT-IDENTICAL to
+  the single-host ``hist="stream"``/streaming fit — not close, equal
+  (tests/test_elastic.py pins it).  Ragged-tail positions contribute
+  exact ``+0`` blocks (zero-packed shards pair bin-0 rows with all-zero
+  value channels), so ONE step program serves every step — program
+  count stays fixed as shard and host counts vary, extending PR-8's
+  contract (analysis/contracts.json ``gbm_regressor.fit_elastic``).
+- ``reduce="psum"``: a single ``psum`` over the row axes — cheaper on
+  DCN (reduce-scatter wire pattern vs a full gather) but f32 addition
+  is not associative, so results are allclose to the single-host fit,
+  not bit-equal.  Use it when throughput beats replayability.
+
+Elasticity: the sweep polls the chaos/runtime ``host_preempt`` hook at
+every step boundary.  The draw is a pure function of ``(seed, fault,
+site)``, so every host reaches the same verdict at the same site
+without communicating; all hosts first drain in-flight collectives,
+then the victim raises :class:`~spark_ensemble_tpu.robustness.chaos.
+ChaosHostPreemption` (and must leave the rendezvous) while survivors
+raise :class:`HostLostError`.  :class:`ElasticCoordinator` catches it,
+rebuilds the mesh from the surviving hosts' devices, and re-enters the
+fit: the orphaned manifest slice is re-dealt automatically (the
+round-robin layout is a pure function of ``(num_shards, W)``) and the
+fit rewinds through the last committed round checkpoint — whose
+fingerprint has no mesh component, so checkpoints are interchangeable
+across mesh shapes.  Because the ordered fold makes every round's math
+partition-invariant, the resumed fit is bit-identical to an
+uninterrupted fit on the surviving mesh (and to the single-host fit).
+
+Single-process "pods": when ``jax.process_count() == 1``, each mesh row
+position plays the role of a host — ``host_preempt`` drops one
+position's devices instead of one process's.  Everything else
+(repartition, rewind, bit-identity) is exercised identically, which is
+what lets tier-1 tests pin the elastic contract on 8 virtual CPU
+devices.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax.experimental.shard_map import shard_map
+
+from spark_ensemble_tpu.data.partition import (
+    PartitionedShardReader,
+    digest_words,
+    manifest_digest,
+    partition_steps,
+)
+from spark_ensemble_tpu.models.base import cached_program
+from spark_ensemble_tpu.ops.binning import CompressedBins, unpack_bins
+from spark_ensemble_tpu.ops.tree import (
+    _HIST_PRECISION,
+    _routing_precision,
+    Tree,
+    stream_leaf_step,
+    stream_level_step,
+)
+from spark_ensemble_tpu.parallel.mesh import (
+    mesh_row_axes,
+    mesh_row_spec,
+)
+from spark_ensemble_tpu.robustness.chaos import ChaosHostPreemption
+
+REDUCE_MODES = ("ordered", "psum")
+
+#: env flag: block around every reduce dispatch and accumulate its wall
+#: share (bench.py's dcn_reduce_share metric).  Off by default — the
+#: fences serialize the sweep, which is a measurement mode, not a
+#: production mode.
+_MEASURE_ENV = "SE_TPU_DIST_MEASURE"
+
+
+class HostLostError(Exception):
+    """A peer host (or, single-process, a mesh row position) was
+    preempted mid-round.  Raised on SURVIVORS only — the victim gets
+    ``ChaosHostPreemption`` — after all in-flight collectives have
+    drained, so catching it and re-entering the fit on a smaller mesh
+    is always safe."""
+
+    def __init__(self, victim: int, site: str):
+        super().__init__(
+            f"host {victim} preempted at {site}; rebuild the mesh from "
+            "the survivors and re-enter the fit (ElasticCoordinator)"
+        )
+        self.victim = int(victim)
+        self.site = site
+
+
+def _mesh_key(mesh: Mesh) -> tuple:
+    """Cache-key fingerprint of a mesh: axis names/sizes plus the flat
+    device-id order.  Two elastic attempts on different surviving
+    meshes must never share a program."""
+    return (
+        tuple(mesh.axis_names),
+        tuple(int(mesh.shape[a]) for a in mesh.axis_names),
+        tuple(int(d.id) for d in mesh.devices.flat),
+    )
+
+
+def surviving_devices(mesh: Mesh, victim: int) -> List:
+    """Devices of ``mesh`` that outlive ``victim``, in mesh-flat order.
+
+    Multi-process: the victim is a process index and all its devices
+    leave.  Single-process: the victim is a mesh row position (the
+    simulated host) and that position's device column leaves.
+    """
+    flat = list(mesh.devices.flat)
+    if jax.process_count() > 1:
+        out = [d for d in flat if d.process_index != victim]
+    else:
+        member = int(mesh.shape.get("member", 1))
+        grid = np.asarray(mesh.devices).reshape(-1, member)
+        out = [d for w in range(grid.shape[0]) if w != victim
+               for d in grid[w]]
+    if not out:
+        raise ValueError(f"no devices survive losing host {victim}")
+    return out
+
+
+def survivor_mesh(mesh: Mesh, victim: int) -> Mesh:
+    """The mesh the fit resumes on after losing ``victim`` — the
+    surviving devices re-laid as a plain ``("data", "member")`` mesh
+    (survivor counts are rarely slice-aligned, so the hybrid DCN axis
+    is not reconstructed; collectives still ride the right links)."""
+    member = int(mesh.shape.get("member", 1))
+    devs = surviving_devices(mesh, victim)
+    arr = np.array(devs).reshape(len(devs) // member, member)
+    return Mesh(arr, ("data", "member"))
+
+
+class DistributedSweep:
+    """The distributed twin of ``data/streaming._sweep_forest``.
+
+    Owns the mesh-global state of one fit: the manifest partition, the
+    per-position shard feeds, the mesh programs (contribution, reduce,
+    node gather, digest agreement) and the preemption hook.  One
+    instance per fit attempt; ``data/streaming`` delegates its shard
+    sweeps here when the fit is given a mesh.
+    """
+
+    def __init__(self, mesh: Mesh, store, *, reduce: str = "ordered",
+                 telem=None):
+        if reduce not in REDUCE_MODES:
+            raise ValueError(
+                f"reduce={reduce!r}; expected one of {REDUCE_MODES}"
+            )
+        if int(mesh.shape.get("member", 1)) != 1:
+            raise ValueError(
+                "distributed streaming shards rows only; use member=1 "
+                f"(got member={mesh.shape.get('member')})"
+            )
+        self.mesh = mesh
+        self.reduce = reduce
+        self.telem = telem
+        self.store = store
+        self.row_axes = mesh_row_axes(mesh)
+        self.row_spec = mesh_row_spec(mesh)
+        self.W = 1
+        for a in self.row_axes:
+            self.W *= int(mesh.shape[a])
+        self.S = int(store.num_shards)
+        self.R = int(store.shard_rows)
+        self.K = partition_steps(self.S, self.W)
+        # flat [W] of row-position devices (member axis is size 1)
+        self._row_devices = list(np.asarray(mesh.devices).reshape(-1))
+        pidx = jax.process_index()
+        self.local_positions = [
+            w for w in range(self.W)
+            if self._row_devices[w].process_index == pidx
+        ]
+        if not self.local_positions:
+            raise ValueError(
+                "this process owns no row position on the mesh; every "
+                "participating process must contribute devices"
+            )
+        # "host" granularity for the preemption fault: processes when
+        # actually multi-process, else simulated per-row-position hosts
+        self.num_hosts = (
+            jax.process_count() if jax.process_count() > 1 else self.W
+        )
+        self.measure = os.environ.get(_MEASURE_ENV, "") == "1"
+        self.reduce_s = 0.0
+        self.sweep_s = 0.0
+        if telem is not None:
+            telem.emit(
+                "dist_config", hosts=self.num_hosts, positions=self.W,
+                steps=self.K, shards=self.S, reduce=reduce,
+                process=pidx,
+            )
+
+    # -- manifest agreement ------------------------------------------------
+
+    def reader(self) -> PartitionedShardReader:
+        """This process's manifest slice as a prefetchable store."""
+        return PartitionedShardReader(
+            self.store, self.local_positions, self.W
+        )
+
+    def check_agreement(self) -> str:
+        """All-gather every position's manifest digest and require them
+        equal — hosts that disagree on the global row count or bin
+        thresholds must fail loudly BEFORE any histogram math."""
+        digest = manifest_digest(self.store)
+        words = digest_words(digest)
+        dig_w = self._row_global(
+            {w: words for w in self.local_positions}, np.uint32
+        )
+        prog = self._digest_prog()
+        all_w = np.asarray(prog(dig_w))
+        bad = [w for w in range(self.W)
+               if not np.array_equal(all_w[w], all_w[0])]
+        if bad:
+            raise ValueError(
+                f"manifest digests disagree across the mesh at row "
+                f"positions {bad}: hosts are not training on the same "
+                "shard store (global n / thresholds mismatch)"
+            )
+        if self.telem is not None:
+            self.telem.emit("dist_manifest_agreed", digest=digest[:16])
+        return digest
+
+    # -- global-array plumbing ---------------------------------------------
+
+    def _row_sharding(self, ndim: int) -> NamedSharding:
+        return NamedSharding(
+            self.mesh,
+            PartitionSpec(self.row_spec, *([None] * (ndim - 1))),
+        )
+
+    def _row_global(self, blocks: dict, dtype) -> jax.Array:
+        """Assemble a ``[W, ...]``-leading global array from this
+        process's per-position host blocks (other processes supply
+        theirs — every process calls this with the same shapes)."""
+        item = next(iter(blocks.values()))
+        shape = (self.W,) + tuple(item.shape)
+        arrs = [
+            jax.device_put(
+                np.asarray(blocks[w], dtype)[None], self._row_devices[w]
+            )
+            for w in self.local_positions
+        ]
+        return jax.make_array_from_single_device_arrays(
+            shape, self._row_sharding(len(shape)), arrs
+        )
+
+    def _fetch(self, arr) -> np.ndarray:
+        """Replicated global array -> host numpy (every process holds a
+        full copy, so the fetch is addressable everywhere)."""
+        return np.asarray(arr)
+
+    # -- mesh programs -----------------------------------------------------
+
+    def _shmap(self, fn, in_specs, out_specs):
+        return jax.jit(
+            shard_map(
+                fn, mesh=self.mesh, in_specs=in_specs,
+                out_specs=out_specs, check_rep=False,
+            )
+        )
+
+    def _digest_prog(self):
+        mk = _mesh_key(self.mesh)
+        row, names = self.row_spec, self.row_axes
+
+        def build():
+            def run(dig):  # [1, 8] per position
+                return jax.lax.all_gather(
+                    dig[0], names, axis=0, tiled=False
+                )  # [W, 8] replicated
+
+            return self._shmap(
+                run, (PartitionSpec(row, None),), PartitionSpec()
+            )
+
+        return cached_program(("dist_digest", mk), build)
+
+    def _zeros_prog(self, shape: tuple, dtype, sharded: bool):
+        """Mesh-placed zeros — initial accumulators (replicated) and
+        per-position node state (row-sharded)."""
+        mk = _mesh_key(self.mesh)
+        spec = (
+            PartitionSpec(self.row_spec, *([None] * (len(shape) - 1)))
+            if sharded
+            else PartitionSpec()
+        )
+        sh = NamedSharding(self.mesh, spec)
+
+        def build():
+            return jax.jit(
+                lambda: jnp.zeros(shape, dtype), out_shardings=sh
+            )
+
+        return cached_program(
+            ("dist_zeros", mk, tuple(shape), np.dtype(dtype).str, sharded),
+            build,
+        )
+
+    def _level_contrib_prog(self, level: int, B: int, bits: int, d: int,
+                            prec: str):
+        """Each position's contribution to level ``level`` at step ``k``:
+        the resident ``stream_level_step`` over its own shard, folded
+        into a FRESH zero accumulator (``0 + D_s`` — see module
+        docstring for why that preserves bit-identity)."""
+        mk = _mesh_key(self.mesh)
+        stat_prec = _HIST_PRECISION[prec]
+        route_prec = _routing_precision(B)
+        n_nodes = 2 ** level
+        row = self.row_spec
+
+        def build():
+            def step(packed, node_w, vals_w, k, tables):
+                # per-position blocks: packed [1,R,words], node [1,K,R,M],
+                # vals [1,K,R,M,C]
+                xb = unpack_bins(
+                    CompressedBins(
+                        packed=packed[0], bits=bits, num_features=d
+                    )
+                )
+                nd = jax.lax.dynamic_index_in_dim(
+                    node_w[0], k, axis=0, keepdims=False
+                )
+                vl = jax.lax.dynamic_index_in_dim(
+                    vals_w[0], k, axis=0, keepdims=False
+                )
+                M, C = vl.shape[1], vl.shape[2]
+                zero = jnp.zeros((M, n_nodes, C, d, B), jnp.float32)
+                contrib, nd = stream_level_step(
+                    zero, xb, nd, vl, n_nodes=n_nodes, tables=tables,
+                    max_bins=B, stat_prec=stat_prec,
+                    route_prec=route_prec,
+                )
+                node_w = jax.lax.dynamic_update_index_in_dim(
+                    node_w[0], nd, k, axis=0
+                )[None]
+                return contrib[None], node_w
+
+            if level == 0:
+                run = lambda packed, node_w, vals_w, k: step(
+                    packed, node_w, vals_w, k, None
+                )
+                in_specs = (
+                    PartitionSpec(row, None, None),
+                    PartitionSpec(row, None, None, None),
+                    PartitionSpec(row, None, None, None, None),
+                    PartitionSpec(),
+                )
+            else:
+                run = lambda packed, node_w, vals_w, k, bf, bt: step(
+                    packed, node_w, vals_w, k, (bf, bt)
+                )
+                in_specs = (
+                    PartitionSpec(row, None, None),
+                    PartitionSpec(row, None, None, None),
+                    PartitionSpec(row, None, None, None, None),
+                    PartitionSpec(),
+                    PartitionSpec(),
+                    PartitionSpec(),
+                )
+            out_specs = (
+                PartitionSpec(row, None, None, None, None, None),
+                PartitionSpec(row, None, None, None),
+            )
+            return self._shmap(run, in_specs, out_specs)
+
+        return cached_program(
+            ("dist_level_contrib", mk, level, B, bits, d, prec), build
+        )
+
+    def _leaf_contrib_prog(self, max_depth: int, B: int, bits: int,
+                           d: int, prec: str):
+        mk = _mesh_key(self.mesh)
+        stat_prec = _HIST_PRECISION[prec]
+        route_prec = _routing_precision(B)
+        num_leaves = 2 ** max_depth
+        row = self.row_spec
+
+        def build():
+            def run(packed, node_w, vals_w, k, bf, bt):
+                xb = unpack_bins(
+                    CompressedBins(
+                        packed=packed[0], bits=bits, num_features=d
+                    )
+                )
+                nd = jax.lax.dynamic_index_in_dim(
+                    node_w[0], k, axis=0, keepdims=False
+                )
+                vl = jax.lax.dynamic_index_in_dim(
+                    vals_w[0], k, axis=0, keepdims=False
+                )
+                M, C = vl.shape[1], vl.shape[2]
+                zero = jnp.zeros((M, num_leaves, C), jnp.float32)
+                contrib, nd = stream_leaf_step(
+                    zero, xb, nd, vl, num_leaves=num_leaves,
+                    tables=(bf, bt), stat_prec=stat_prec,
+                    route_prec=route_prec,
+                )
+                node_w = jax.lax.dynamic_update_index_in_dim(
+                    node_w[0], nd, k, axis=0
+                )[None]
+                return contrib[None], node_w
+
+            in_specs = (
+                PartitionSpec(row, None, None),
+                PartitionSpec(row, None, None, None),
+                PartitionSpec(row, None, None, None, None),
+                PartitionSpec(),
+                PartitionSpec(),
+                PartitionSpec(),
+            )
+            out_specs = (
+                PartitionSpec(row, None, None, None),
+                PartitionSpec(row, None, None, None),
+            )
+            return self._shmap(run, in_specs, out_specs)
+
+        return cached_program(
+            ("dist_leaf_contrib", mk, max_depth, B, bits, d, prec), build
+        )
+
+    def _reduce_prog(self):
+        """Fold the W per-position contributions into the running
+        accumulator: static position-order unroll under ``ordered``
+        (bit-exact, see module docstring), one ``psum`` otherwise.
+        Shape-polymorphic: one cached program serves every level and
+        the leaf sweep (jit re-traces per shape under the same key)."""
+        mk = _mesh_key(self.mesh)
+        mode = self.reduce
+        names = self.row_axes
+        row = self.row_spec
+        W = self.W
+
+        def build():
+            def run(acc, contrib):  # acc replicated, contrib [1, ...]
+                c = contrib[0]
+                if mode == "psum":
+                    return acc + jax.lax.psum(c, names)
+                gathered = jax.lax.all_gather(
+                    c, names, axis=0, tiled=False
+                )  # [W, ...] — position-major == global shard order
+                for w in range(W):
+                    acc = acc + gathered[w]
+                return acc
+
+            # shard_map in_specs depend on rank, so keep one jitted
+            # instance per contrib rank (jit itself re-traces per shape)
+            jits = {}
+
+            def runner(acc, contrib):
+                f = jits.get(contrib.ndim)
+                if f is None:
+                    in_specs = (
+                        PartitionSpec(),
+                        PartitionSpec(row, *([None] * (contrib.ndim - 1))),
+                    )
+                    f = jits.setdefault(
+                        contrib.ndim,
+                        self._shmap(run, in_specs, PartitionSpec()),
+                    )
+                return f(acc, contrib)
+
+            return runner
+
+        return cached_program(("dist_reduce", mk, mode), build)
+
+    def _gather_nodes_prog(self):
+        """Collect every position's swept node ids back into the
+        single-host ``node_all [S, R, M]`` layout (exact int ops)."""
+        mk = _mesh_key(self.mesh)
+        names = self.row_axes
+        row = self.row_spec
+        W, K, S = self.W, self.K, self.S
+
+        def build():
+            def run(node_w):  # [1, K, R, M] per position
+                g = jax.lax.all_gather(
+                    node_w[0], names, axis=0, tiled=False
+                )  # [W, K, R, M]
+                g = jnp.transpose(g, (1, 0, 2, 3))  # [K, W, R, M]
+                return g.reshape((K * W,) + g.shape[2:])[:S]
+
+            return self._shmap(
+                run,
+                (PartitionSpec(row, None, None, None),),
+                PartitionSpec(),
+            )
+
+        return cached_program(("dist_gather_nodes", mk, K, S), build)
+
+    # -- sweep mechanics ---------------------------------------------------
+
+    def _scatter_vals(self, vals_np: np.ndarray) -> jax.Array:
+        """Host ``vals_p [S, R, M, C]`` -> global ``[W, K, R, M, C]``
+        in round-robin step-major layout; steps past the manifest end
+        are zero blocks (exact ``+0`` contributions)."""
+        S, R, M, C = vals_np.shape
+        zero = np.zeros((R, M, C), np.float32)
+        blocks = {}
+        for w in self.local_positions:
+            blocks[w] = np.stack([
+                vals_np[k * self.W + w]
+                if k * self.W + w < S else zero
+                for k in range(self.K)
+            ])
+        return self._row_global(blocks, np.float32)
+
+    def _collect_step(self, sweep_iter) -> jax.Array:
+        """Next P prefetched blocks -> global ``packed [W, R, words]``
+        for one step (the reader yields step-major, position order)."""
+        blocks = {}
+        for w in self.local_positions:
+            _, packed = next(sweep_iter)
+            blocks[w] = np.asarray(packed)
+        return self._row_global(blocks, np.uint32)
+
+    def _run_reduce(self, red, acc, contrib):
+        if not self.measure:
+            return red(acc, contrib)
+        t0 = time.perf_counter()
+        acc = red(acc, contrib)
+        jax.block_until_ready(acc)
+        self.reduce_s += time.perf_counter() - t0
+        return acc
+
+    def _maybe_preempt(self, ctl, site: str, *pending):
+        """Chaos seam: symmetric deterministic verdict, drain, then
+        victim/survivor-specific raise (see chaos.host_preempt)."""
+        hook = getattr(ctl, "host_preempt", None)
+        if hook is None or not hook(site):
+            return
+        victim = ctl.pick("host_preempt", site, self.num_hosts)
+        # drain: nobody may stop participating while a collective is in
+        # flight, or the survivors hang inside XLA instead of rewinding
+        # graftlint: ignore[unfenced-blocking-read] -- preemption teardown path; the fit is being abandoned, there is no dispatch pipeline left to charge the wait to
+        jax.block_until_ready([p for p in pending if p is not None])
+        if self.telem is not None:
+            self.telem.emit("host_preempted", victim=victim, site=site)
+        if jax.process_count() > 1 and victim == jax.process_index():
+            raise ChaosHostPreemption(
+                f"chaos: host {victim} preempted at {site}"
+            )
+        raise HostLostError(victim, site)
+
+    def sweep_forest(self, prefetch, ctl, site, vals_p, y_mean, mask,
+                     thresholds, *, max_depth, B, bits, d, prec,
+                     min_gain):
+        """Distributed twin of ``streaming._sweep_forest``: same
+        signature, same return contract ``(Tree [M, ...], node_all
+        [S, R, M])``, bit-identical outputs under ``reduce="ordered"``.
+        The level/leaf *finish* programs stay host-local and shared
+        with the single-host path — only the sweeps ride the mesh."""
+        from spark_ensemble_tpu.data.streaming import (
+            _leaf_finish_prog,
+            _level_finish_prog,
+        )
+
+        S, R, M, C = vals_p.shape
+        t_fetch0 = time.perf_counter()
+        vals_np = np.asarray(vals_p)
+        if self.telem is not None:
+            self.telem.host_blocked(time.perf_counter() - t_fetch0)
+        vals_w = self._scatter_vals(vals_np)
+        node_w = self._zeros_prog(
+            (self.W, self.K, R, M), np.int32, sharded=True
+        )()
+        num_internal = 2 ** max_depth - 1
+        sf = jnp.zeros((M, num_internal), jnp.int32)
+        sb = jnp.zeros((M, num_internal), jnp.int32)
+        stt = jnp.zeros((M, num_internal), jnp.float32)
+        sg = jnp.zeros((M, num_internal), jnp.float32)
+        parent_value = y_mean[:, None, :]
+        best_f = best_t = None
+        bf_np = bt_np = None
+        red = self._reduce_prog()
+        thread = f"host{jax.process_index()}"
+        for level in range(max_depth):
+            t_lvl = time.time()
+            t0 = time.perf_counter()
+            prog = self._level_contrib_prog(level, B, bits, d, prec)
+            acc = self._zeros_prog(
+                (M, 2 ** level, C, d, B), np.float32, sharded=False
+            )()
+            sweep_iter = prefetch.sweep()
+            for k in range(self.K):
+                self._maybe_preempt(
+                    ctl, f"{site}:level:{level}:dist_step:{k}",
+                    acc, node_w,
+                )
+                packed_w = self._collect_step(sweep_iter)
+                if level == 0:
+                    contrib, node_w = prog(
+                        packed_w, node_w, vals_w, np.int32(k)
+                    )
+                else:
+                    contrib, node_w = prog(
+                        packed_w, node_w, vals_w, np.int32(k),
+                        bf_np, bt_np,
+                    )
+                acc = self._run_reduce(red, acc, contrib)
+            # replicated accumulator -> host-local operands for the
+            # SHARED finish program (byte-identical to single-host)
+            t_fetch0 = time.perf_counter()
+            acc_h = jnp.asarray(self._fetch(acc))
+            if self.telem is not None:
+                self.telem.host_blocked(time.perf_counter() - t_fetch0)
+            fin = _level_finish_prog(level, B, d, prec, min_gain)
+            best_f, best_t, parent_value, sf, sb, stt, sg = fin(
+                acc_h, mask, thresholds, parent_value, sf, sb, stt, sg
+            )
+            # the contribution programs take the tables as replicated
+            # host values: every process feeds the same bytes, which is
+            # exactly what multi-process jit requires of non-addressable
+            # inputs
+            t_fetch0 = time.perf_counter()
+            bf_np = np.asarray(best_f)
+            bt_np = np.asarray(best_t)
+            dur = time.perf_counter() - t0
+            if self.telem is not None:
+                self.telem.host_blocked(time.perf_counter() - t_fetch0)
+                self.telem.emit_span(
+                    f"dist_level_{level}", t_lvl, dur, thread=thread,
+                    steps=self.K,
+                )
+            self.sweep_s += dur
+        t_lvl = time.time()
+        t0 = time.perf_counter()
+        leaf = self._leaf_contrib_prog(max_depth, B, bits, d, prec)
+        acc = self._zeros_prog(
+            (M, 2 ** max_depth, C), np.float32, sharded=False
+        )()
+        sweep_iter = prefetch.sweep()
+        for k in range(self.K):
+            self._maybe_preempt(
+                ctl, f"{site}:leaf:dist_step:{k}", acc, node_w
+            )
+            packed_w = self._collect_step(sweep_iter)
+            contrib, node_w = leaf(
+                packed_w, node_w, vals_w, np.int32(k), bf_np, bt_np
+            )
+            acc = self._run_reduce(red, acc, contrib)
+        t_fetch0 = time.perf_counter()
+        acc_h = jnp.asarray(self._fetch(acc))
+        node_all = jnp.asarray(
+            self._fetch(self._gather_nodes_prog()(node_w))
+        )
+        if self.telem is not None:
+            self.telem.host_blocked(time.perf_counter() - t_fetch0)
+        leaf_value = _leaf_finish_prog()(acc_h, parent_value, y_mean)
+        dur = time.perf_counter() - t0
+        if self.telem is not None:
+            self.telem.emit_span(
+                "dist_leaf", t_lvl, dur, thread=thread, steps=self.K
+            )
+        self.sweep_s += dur
+        tree = Tree(
+            split_feature=sf, split_bin=sb, split_threshold=stt,
+            leaf_value=leaf_value, split_gain=sg,
+        )
+        return tree, node_all
+
+    def take_stats(self) -> dict:
+        """Cumulative sweep/reduce wall (reduce only measured under
+        SE_TPU_DIST_MEASURE=1); resets the counters."""
+        out = {"sweep_s": self.sweep_s, "reduce_s": self.reduce_s}
+        self.sweep_s = 0.0
+        self.reduce_s = 0.0
+        return out
+
+
+#: stats of the most recent distributed fit in this process (bench.py
+#: reads the reduce share from here — the sweep object itself lives and
+#: dies inside the fit call)
+_LAST_FIT_STATS: dict = {}
+
+
+def last_fit_stats() -> dict:
+    return dict(_LAST_FIT_STATS)
+
+
+def _record_fit_stats(dist: DistributedSweep) -> None:
+    stats = dist.take_stats()
+    _LAST_FIT_STATS.clear()
+    _LAST_FIT_STATS.update(stats)
+    if dist.telem is not None:
+        dist.telem.emit(
+            "dist_sweep",
+            sweep_us=int(stats["sweep_s"] * 1e6),
+            reduce_us=int(stats["reduce_s"] * 1e6),
+        )
+
+
+class ElasticCoordinator:
+    """Detect -> drain -> repartition -> rewind -> resume.
+
+    Wraps a distributed ``fit_streaming`` call in the preemption-
+    recovery loop: on :class:`HostLostError` the coordinator rebuilds
+    the mesh from the survivors (``survivor_mesh``), and re-enters the
+    fit — which repartitions the manifest over the new mesh for free
+    (round-robin is a pure function of the mesh width) and rewinds
+    through the estimator's last committed round checkpoint.  Give the
+    estimator a ``checkpoint_dir`` or the "rewind" is a full replay
+    from round 0 (still bit-identical, just slower).
+
+    The victim process must NOT use this class to keep training — it
+    receives ``ChaosHostPreemption`` (or a real SIGTERM) and leaves;
+    ``max_losses`` bounds how many peers the survivors will absorb.
+    """
+
+    def __init__(self, mesh: Mesh, *, reduce: str = "ordered",
+                 max_losses: int = 2):
+        if reduce not in REDUCE_MODES:
+            raise ValueError(
+                f"reduce={reduce!r}; expected one of {REDUCE_MODES}"
+            )
+        self.mesh = mesh
+        self.reduce = reduce
+        self.max_losses = int(max_losses)
+        #: (victim, site, surviving_width) per absorbed preemption
+        self.losses: List[Tuple[int, str, int]] = []
+
+    def fit_streaming(self, est, store, y, **kw):
+        """Run ``est.fit_streaming(store, y, mesh=..., reduce=...)``
+        to completion, absorbing up to ``max_losses`` host losses.
+        Returns the fitted model; ``self.mesh`` ends as the mesh the
+        fit actually finished on."""
+        while True:
+            try:
+                return est.fit_streaming(
+                    store, y, mesh=self.mesh, reduce=self.reduce, **kw
+                )
+            except HostLostError as e:
+                if len(self.losses) >= self.max_losses:
+                    raise
+                self.mesh = survivor_mesh(self.mesh, e.victim)
+                width = int(np.prod([
+                    self.mesh.shape[a]
+                    for a in mesh_row_axes(self.mesh)
+                ]))
+                self.losses.append((e.victim, e.site, width))

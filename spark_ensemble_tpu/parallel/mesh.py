@@ -52,9 +52,13 @@ def data_member_mesh(
 
 
 def hybrid_data_member_mesh(
-    dcn_data: int = 1, member: int = 1, devices: Optional[Sequence] = None
+    dcn_data=1, member: int = 1, devices: Optional[Sequence] = None
 ) -> Mesh:
     """Multi-slice pod mesh: ``("dcn_data", "data", "member")``.
+
+    ``dcn_data="auto"`` sizes the DCN axis to the slice count of the
+    participating devices (``multihost.slice_count``) — the recipe pod
+    users previously copy-pasted from the multihost module docstring.
 
     The outer ``dcn_data`` axis spans slices over DCN; ``data`` and
     ``member`` stay within a slice on ICI.  Row reductions then decompose
@@ -72,6 +76,11 @@ def hybrid_data_member_mesh(
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
+    if dcn_data == "auto":
+        from spark_ensemble_tpu.parallel.multihost import slice_count
+
+        dcn_data = slice_count(devices)
+    dcn_data = int(dcn_data)
     if n % (dcn_data * member) != 0:
         raise ValueError(
             f"dcn_data={dcn_data} * member={member} must divide {n} devices"

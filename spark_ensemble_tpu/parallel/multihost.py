@@ -18,11 +18,12 @@ Typical pod usage (same program on every host):
     from spark_ensemble_tpu.parallel import multihost, mesh
 
     multihost.initialize()                    # auto-detect on Cloud TPU
-    # dcn_data = SLICE count (NOT host count: one slice may span several
-    # host processes, and the DCN axis groups by slice)
-    n_slices = len({getattr(d, "slice_index", 0) for d in jax.devices()})
-    m = mesh.hybrid_data_member_mesh(dcn_data=max(n_slices, 1))
+    m = mesh.hybrid_data_member_mesh(dcn_data="auto")  # dcn_data = slice count
     model = GBMClassifier(...).fit(X_local, y_local, mesh=m)
+
+(``dcn_data="auto"`` resolves via :func:`slice_count` — the SLICE count,
+NOT the host count: one slice may span several host processes, and the
+DCN axis groups by slice.)
 
 (Every process must pass the same global arrays / shardings; use
 ``jax.make_array_from_process_local_data`` for per-host input pipelines.)
@@ -83,6 +84,19 @@ def initialize(
         process_id=process_id,
     )
     _initialized = True
+
+
+def slice_count(devices: Optional[list] = None) -> int:
+    """Number of distinct TPU slices across ``devices`` (default: all).
+
+    This is the right ``dcn_data`` axis size for
+    ``mesh.hybrid_data_member_mesh``: the DCN axis groups devices by
+    slice, and one slice may span several host processes, so neither
+    ``process_count()`` nor host count is a substitute.  Devices without
+    a ``slice_index`` (CPU, single-slice) count as one slice.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    return max(len({getattr(d, "slice_index", 0) for d in devs}), 1)
 
 
 def process_count() -> int:

@@ -24,6 +24,7 @@ class systems treat recoverability as a first-class feature (arXiv
 
 from spark_ensemble_tpu.robustness.chaos import (
     ChaosController,
+    ChaosHostPreemption,
     ChaosPreemption,
     ChaosReplicaCrash,
     ChaosTransientError,
@@ -38,6 +39,7 @@ from spark_ensemble_tpu.robustness.validate import validate_fit_inputs
 
 __all__ = [
     "ChaosController",
+    "ChaosHostPreemption",
     "ChaosPreemption",
     "ChaosReplicaCrash",
     "ChaosTransientError",

@@ -40,6 +40,9 @@ FAULT_KINDS = (
     "nan_grad", "preempt", "transient", "ckpt_corrupt",
     # serving-fleet faults (fired from FleetRouter replica workers only)
     "replica_stall", "replica_crash", "slow_reply",
+    # elastic-training fault (fired from the distributed sweep only):
+    # kills one whole host mid-round; survivors repartition and resume
+    "host_preempt",
 )
 
 
@@ -52,6 +55,14 @@ class ChaosPreemption(Exception):
 class ChaosTransientError(RuntimeError):
     """Injected transient device error; a ``RuntimeError`` on purpose so
     the retry/backoff layer treats it like a real XLA hiccup."""
+
+
+class ChaosHostPreemption(Exception):
+    """Injected whole-host kill during a distributed sweep.  Raised only
+    on the *victim* process (survivors get ``elastic.HostLostError``
+    instead); not a ``RuntimeError`` so no retry layer can swallow it —
+    the victim must actually leave the mesh, exactly like a real pod
+    preemption notice."""
 
 
 class ChaosReplicaCrash(Exception):
@@ -96,6 +107,9 @@ class ChaosController:
             # one replica death per run by default: the fleet should absorb
             # a single kill; unbounded kills is a different experiment
             "replica_crash": 1,
+            # likewise one host loss per run: survivors must prove one
+            # clean repartition+resume, not survive a dying pod
+            "host_preempt": 1,
         }
         if budgets:
             self.budgets.update(budgets)
@@ -217,6 +231,17 @@ class ChaosController:
         except OSError:
             logger.exception("chaos: could not corrupt %s", state_path)
 
+    def host_preempt(self, site: str) -> bool:
+        """Whether a host preemption fires at this site (globally
+        budgeted; default 1).  Unlike :meth:`preempt` this returns a
+        verdict instead of raising: the caller (the distributed sweep)
+        must first drain in-flight collectives and resolve the victim
+        via :meth:`pick` — and then raises ``ChaosHostPreemption`` on
+        the victim, ``HostLostError`` on survivors.  The draw is a pure
+        function of ``(seed, fault, site)``, so every host reaches the
+        same verdict at the same site without communicating."""
+        return self._fire("host_preempt", site)
+
     # -- serving-fleet hooks (called from FleetRouter replica workers) -----
 
     def stall_s(self, site: str, seconds: float = 0.25) -> float:
@@ -264,6 +289,9 @@ class _NoopController:
 
     def stall_s(self, site: str, seconds: float = 0.25) -> float:
         return 0.0
+
+    def host_preempt(self, site: str) -> bool:
+        return False
 
     def crash(self, site: str) -> None:
         pass

@@ -1,24 +1,228 @@
-"""Worker for the 2-process ``jax.distributed`` rendezvous test (run by
+"""Worker for the 2-process ``jax.distributed`` rendezvous tests (run by
 ``tests/test_multiprocess.py`` as a subprocess, once per process id).
 
 Joins the CPU rendezvous via ``parallel.multihost.initialize`` — the
-process_count>1 branch a single-process suite can never execute — builds a
-GLOBAL 4-device mesh (2 processes x 2 virtual CPU devices), and runs one
-psum-ed GBMRegressor fit step over it.  Prints ``MULTIHOST_OK`` only if the
-fitted params are finite and every cross-process collective completed.
+process_count>1 branch a single-process suite can never execute — and builds
+a GLOBAL 4-device mesh (2 processes x 2 virtual CPU devices).  Three modes
+(``argv[3]``, default ``basic``):
+
+- ``basic``: one psum-ed GBMRegressor fit step over the global mesh; prints
+  ``MULTIHOST_OK`` only if the fitted params are finite and every
+  cross-process collective completed.
+- ``dist``: distributed-histogram streaming fits over the global mesh with
+  each process reading only its manifest slice (subset-verified store
+  opens); asserts bit-identity against a process-local single-host
+  streaming fit and a FIXED traced-program count across two shard sizes;
+  prints ``DIST_OK``.
+- ``elastic``: a deterministic mid-round ``host_preempt`` kills process 1;
+  the survivor rewinds to the last committed round checkpoint, repartitions
+  the orphaned manifest slice onto its own devices, resumes, and asserts
+  bit-identity against the uninterrupted reference; prints ``ELASTIC_OK``
+  (survivor) / ``PREEMPT_EXIT_OK`` (victim).
+
+``dist``/``elastic`` take a shared scratch directory as ``argv[4]`` and
+write per-host telemetry JSONL next to it (``telemetry_p{pid}.jsonl``).
 """
 
 import os
 import sys
+import time
+
+
+def _await_file(path, timeout=300.0):
+    deadline = time.monotonic() + timeout
+    while not os.path.exists(path):
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"timed out waiting for {path}")
+        time.sleep(0.05)
+
+
+def _touch(path):
+    with open(path, "w") as f:
+        f.write("ok\n")
+
+
+def _assert_bit_identical(m1, m2):
+    import jax
+    import numpy as np
+
+    l1 = jax.tree_util.tree_leaves(m1.params)
+    l2 = jax.tree_util.tree_leaves(m2.params)
+    assert len(l1) == len(l2)
+    for a, b in zip(l1, l2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _make_store(workdir, pid, shard_rows, name):
+    """Process 0 seals the store; process 1 waits, then opens a
+    subset-verified handle covering only the shards its mesh row
+    positions will ever read."""
+    import numpy as np
+
+    from spark_ensemble_tpu.data import write_shards
+    from spark_ensemble_tpu.data.partition import partition_shards
+    from spark_ensemble_tpu.data.shards import ShardStore
+
+    sdir = os.path.join(workdir, name)
+    ready = sdir + ".ready"
+    rng = np.random.RandomState(7)
+    X = rng.randn(300, 6).astype(np.float32)
+    y = (X @ rng.randn(6) + 0.1 * rng.randn(300)).astype(np.float32)
+    if pid == 0:
+        store = write_shards(X, sdir, max_bins=16, shard_rows=shard_rows)
+        _touch(ready)
+    else:
+        _await_file(ready)
+        store = ShardStore.open(sdir)
+    # re-open with only this host's manifest slice verified: positions
+    # {2*pid, 2*pid+1} of the 4-wide row mesh, round-robin over shards
+    mine = set()
+    for w in (2 * pid, 2 * pid + 1):
+        mine.update(partition_shards(store.num_shards, 4, w))
+    sub = ShardStore.open(sdir, shards=sorted(mine))
+    assert sub.verified_shards == frozenset(mine)
+    return store, sub, X, y
+
+
+def _streaming_reg(ckdir=None):
+    from spark_ensemble_tpu import DecisionTreeRegressor, GBMRegressor
+
+    kw = dict(
+        base_learner=DecisionTreeRegressor(
+            max_depth=3, max_bins=16, hist="stream"
+        ),
+        num_base_learners=3,
+        seed=0,
+    )
+    if ckdir is not None:
+        kw.update(checkpoint_dir=ckdir, checkpoint_interval=1)
+    return GBMRegressor(**kw)
+
+
+def _run_dist(pid, workdir) -> int:
+    """Distributed-histogram fits over the REAL two-process mesh: each
+    host streams only its manifest slice, the reduce crosses the process
+    boundary, and the result must match a process-local single-host fit
+    bit-for-bit — with one traced-program count across shard sizes."""
+    from spark_ensemble_tpu.analysis.contracts import _ProgramRecorder
+    from spark_ensemble_tpu.models.base import observe_program_calls
+    from spark_ensemble_tpu.parallel.mesh import data_member_mesh
+
+    mesh = data_member_mesh(4, member=1)
+    counts = {}
+    for shard_rows in (32, 16):
+        store, sub, X, y = _make_store(
+            workdir, pid, shard_rows, f"store{shard_rows}"
+        )
+        rec = _ProgramRecorder()
+        with observe_program_calls(rec):
+            dist = _streaming_reg().fit_streaming(sub, y, mesh=mesh)
+        counts[shard_rows] = rec.count()
+        if shard_rows == 32:
+            ref = _streaming_reg().fit_streaming(store, y)
+            _assert_bit_identical(ref, dist)
+    assert len(set(counts.values())) == 1, counts
+    print("DIST_OK", flush=True)
+    return 0
+
+
+class _HostPreemptAt:
+    """Deterministic single-shot host_preempt at one site, pinned victim."""
+
+    enabled = True
+
+    def __init__(self, site, victim):
+        self.site = site
+        self.victim = victim
+        self.fired = []
+
+    def host_preempt(self, site):
+        if site == self.site and not self.fired:
+            self.fired.append(site)
+            return True
+        return False
+
+    def pick(self, fault, site, n):
+        return self.victim % n
+
+    def preempt(self, site):
+        pass
+
+    def transient(self, site):
+        pass
+
+    def poison_array(self, site, arr):
+        return arr
+
+    def poison_member_stack(self, site, tree):
+        return tree
+
+    def poison_tree(self, site, tree):
+        return tree
+
+    def corrupt_checkpoint(self, site, state_path):
+        pass
+
+
+def _run_elastic(pid, workdir) -> int:
+    """Mid-round host_preempt kills process 1; process 0 rewinds to the
+    last committed round checkpoint, repartitions the orphaned slice
+    onto its own devices, resumes, and must land on the same bits as an
+    uninterrupted fit.  The victim stays parked until the survivor
+    signals completion so the rendezvous stays alive."""
+    from spark_ensemble_tpu.parallel.elastic import ElasticCoordinator
+    from spark_ensemble_tpu.parallel.mesh import data_member_mesh
+    from spark_ensemble_tpu.robustness import chaos
+    from spark_ensemble_tpu.robustness.chaos import ChaosHostPreemption
+
+    mesh = data_member_mesh(4, member=1)
+    store, _sub, X, y = _make_store(workdir, pid, 32, "store_el")
+    done = os.path.join(workdir, "elastic.done")
+
+    site = "GBMRegressor:stream_round:2:level:1:dist_step:1"
+    chaos.install(_HostPreemptAt(site, victim=1))
+    coord = ElasticCoordinator(mesh)
+    try:
+        model = coord.fit_streaming(
+            _streaming_reg(os.path.join(workdir, f"ck{pid}")), store, y
+        )
+    except ChaosHostPreemption:
+        # this process IS the preempted host: park until the survivor
+        # finishes (exiting would tear down the coordination service)
+        print("PREEMPTED", flush=True)
+        _await_file(done)
+        print("PREEMPT_EXIT_OK", flush=True)
+        return 0
+    finally:
+        chaos.install(None)
+
+    assert pid == 0, "victim process must not complete the fit"
+    assert [(v, s) for v, s, _ in coord.losses] == [(1, site)]
+    assert coord.mesh.shape["data"] == 2  # survivors repartitioned
+    ref = _streaming_reg().fit_streaming(store, y)
+    _assert_bit_identical(ref, model)
+    _touch(done)
+    print("ELASTIC_OK", flush=True)
+    return 0
 
 
 def main() -> int:
     port = sys.argv[1]
     pid = int(sys.argv[2])
+    mode = sys.argv[3] if len(sys.argv) > 3 else "basic"
+    workdir = sys.argv[4] if len(sys.argv) > 4 else None
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    if workdir is not None:
+        os.environ["SE_TPU_TELEMETRY"] = os.path.join(
+            workdir, f"telemetry_p{pid}.jsonl"
+        )
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    try:  # cross-process CPU collectives need the gloo transport
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
 
     from spark_ensemble_tpu.parallel import multihost
 
@@ -31,6 +235,12 @@ def main() -> int:
     assert multihost.process_index() == pid
     assert len(jax.devices()) == 4, jax.devices()
     assert multihost.local_device_count() == 2
+
+    if mode == "dist":
+        return _run_dist(pid, workdir)
+    if mode == "elastic":
+        return _run_elastic(pid, workdir)
+    assert mode == "basic", mode
 
     # a raw cross-process psum first: the global mesh's collective seam
     import jax.numpy as jnp

@@ -100,3 +100,13 @@ def test_streaming_budgets_traced(traced):
     assert traced.budgets["gbm_classifier.fit_streaming"] >= 1
     assert "gbm_regressor.fit_streaming" not in traced.skipped
     assert "gbm_classifier.fit_streaming" not in traced.skipped
+
+
+def test_distributed_budget_traced(traced):
+    # the pod-scale elastic plane (parallel/elastic.py) pins ONE program
+    # inventory across mesh widths AND shard counts: the tracer runs the
+    # distributed fit at 2x2 configurations and appends a "distributed"
+    # violation on any variation, so the empty violation list above IS
+    # the fixed-program-count contract; here pin that it traced at all
+    assert traced.budgets["gbm_regressor.fit_streaming_dist"] >= 1
+    assert "gbm_regressor.fit_streaming_dist" not in traced.skipped

@@ -25,8 +25,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_rendezvous_psum_fit():
+def _run_workers(extra_args=()):
+    """Launch the 2-process worker pair; returns the per-process outputs
+    (skips when the sandbox forbids loopback sockets)."""
     try:
         port = _free_port()
     except OSError as e:  # environment forbids sockets
@@ -39,7 +40,7 @@ def test_two_process_rendezvous_psum_fit():
     env.pop("XLA_FLAGS", None)
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, str(port), str(pid)],
+            [sys.executable, _WORKER, str(port), str(pid), *extra_args],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
@@ -58,4 +59,33 @@ def test_two_process_rendezvous_psum_fit():
         pytest.fail("multi-process rendezvous timed out (420s)")
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out[-3000:]}"
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_rendezvous_psum_fit():
+    outs = _run_workers()
+    for pid, out in enumerate(outs):
         assert "MULTIHOST_OK" in out, f"process {pid} incomplete:\n{out[-3000:]}"
+
+
+@pytest.mark.slow
+def test_two_process_distributed_histograms(tmp_path):
+    """Each host streams only its manifest slice; the cross-DCN reduce
+    must land on the single-host bits with a FIXED program count."""
+    outs = _run_workers(("dist", str(tmp_path)))
+    for pid, out in enumerate(outs):
+        assert "DIST_OK" in out, f"process {pid} incomplete:\n{out[-3000:]}"
+    # per-host telemetry JSONL written for both processes
+    for pid in (0, 1):
+        assert (tmp_path / f"telemetry_p{pid}.jsonl").exists()
+
+
+@pytest.mark.slow
+def test_two_process_elastic_preempt_resume(tmp_path):
+    """Process 1 dies to a live host_preempt mid-round; process 0 rewinds,
+    repartitions the orphaned slice, and resumes bit-identically."""
+    outs = _run_workers(("elastic", str(tmp_path)))
+    assert "ELASTIC_OK" in outs[0], f"survivor incomplete:\n{outs[0][-3000:]}"
+    assert "PREEMPTED" in outs[1], f"victim not preempted:\n{outs[1][-3000:]}"
+    assert "PREEMPT_EXIT_OK" in outs[1], outs[1][-3000:]

@@ -54,6 +54,12 @@ METRICS: Dict[str, Any] = {
     "shard_wait_share":      ("lower", 0.25, 0.02),
     "compiles_since_warmup": ("lower", 0.0, 0.0),     # zero-compile contract
     "trace_overhead_pct":    ("lower", 0.50, 1.0),    # disabled-path <1%
+    # pod-scale leg (parallel/elastic.py): rows/sec through the
+    # distributed-histogram plane, and the ordered reduce's share of
+    # sweep wall (the DCN-hop fraction on a real pod; measured under
+    # serializing fences, so it is noisy — wide floors)
+    "multihost_rows_per_sec": ("higher", 0.25, 0.0),
+    "dcn_reduce_share":       ("lower", 0.25, 0.05),
 }
 
 
