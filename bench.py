@@ -861,6 +861,7 @@ def inner():
     _, off_fit_s = _timed_fit(est.copy(on_nonfinite="off"), X, y)
     robustness_overhead_pct = 100.0 * (base_fit_s - off_fit_s) / off_fit_s
     telemetry_phase_shares = {}
+    cost_model_errs: list = []
     try:
         with open(tel_path) as f:
             for line in f:
@@ -871,8 +872,20 @@ def inner():
                         k: round(float(v) / wall, 4)
                         for k, v in ev.get("phases", {}).items()
                     }
+                elif (
+                    ev.get("event") == "round_end"
+                    and "cost_model_error_pct" in ev
+                ):
+                    # measured-vs-estimated ledger (telemetry/events.py):
+                    # the roofline model's per-round error; medianed below
+                    # and pinned by the perf sentinel
+                    cost_model_errs.append(float(ev["cost_model_error_pct"]))
     except (OSError, json.JSONDecodeError):
         pass
+    cost_model_errs.sort()
+    cost_model_error_pct = (
+        cost_model_errs[len(cost_model_errs) // 2] if cost_model_errs else None
+    )
 
     # pipeline A/B (docs/pipeline.md): the same headline fit with the
     # lookahead dispatch pipeline pinned OFF (SE_TPU_PIPELINE=0, the
@@ -1035,6 +1048,11 @@ def inner():
         "hist_precision": hist_precision,
         "telemetry_overhead_pct": round(telemetry_overhead_pct, 2),
         "trace_overhead_pct": round(trace_overhead_pct, 2),
+        "cost_model_error_pct": (
+            round(cost_model_error_pct, 2)
+            if cost_model_error_pct is not None
+            else None
+        ),
         "telemetry_phase_shares": telemetry_phase_shares,
         "robustness_overhead_pct": round(robustness_overhead_pct, 2),
         "serving_rows_per_sec": round(serving_rows_per_sec, 1),
@@ -1189,15 +1207,22 @@ def inner():
             mh_est = st_est.copy()
             os.environ["SE_TPU_DIST_MEASURE"] = "1"
             try:
-                _block_on_model(
-                    mh_est.copy().fit_streaming(store, ys, mesh=mh_mesh)
-                )  # warmup
+                # the warmup leg rides under record_fits so its dist_level
+                # spans feed the pod skew report (telemetry/podview.py) —
+                # the timed leg stays sink-free so rows/sec is unpolluted
+                with _rf() as mh_rec:
+                    _block_on_model(
+                        mh_est.copy().fit_streaming(store, ys, mesh=mh_mesh)
+                    )  # warmup
                 t0 = time.perf_counter()
                 _block_on_model(mh_est.fit_streaming(store, ys, mesh=mh_mesh))
                 mh_s = time.perf_counter() - t0
             finally:
                 os.environ.pop("SE_TPU_DIST_MEASURE", None)
             mh_stats = _elastic.last_fit_stats()
+            from spark_ensemble_tpu.telemetry import podview as _podview
+
+            pod_skew = _podview.skew_report([mh_rec.events])
             multihost = {
                 "positions": mh_w,
                 "rows": st_rows_cap,
@@ -1212,6 +1237,8 @@ def inner():
                     / max(mh_stats.get("sweep_s", 0.0), 1e-9),
                     4,
                 ),
+                "pod_skew_ratio": round(pod_skew["pod_skew_ratio"], 3),
+                "pod_skew_offender": pod_skew["persistent_offender"],
             }
     except Exception as e:  # noqa: BLE001 - carry, keep going
         multihost = {"error": str(e)[:200]}
@@ -1219,6 +1246,7 @@ def inner():
     if "rows_per_sec" in multihost:
         out["multihost_rows_per_sec"] = multihost["rows_per_sec"]
         out["dcn_reduce_share"] = multihost["dcn_reduce_share"]
+        out["pod_skew_ratio"] = multihost["pod_skew_ratio"]
 
     extras = {}
     if os.environ.get("BENCH_FULL") == "1":

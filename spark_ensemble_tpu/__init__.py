@@ -93,12 +93,16 @@ from spark_ensemble_tpu.tuning import (
 from spark_ensemble_tpu import telemetry
 from spark_ensemble_tpu.telemetry import (
     FitTelemetry,
+    FlightRecorder,
     MetricsRegistry,
     Span,
     TelemetryRecorder,
     TraceContext,
     Tracer,
+    dump_flight,
     record_fits,
+    skew_report,
+    stitch_files,
     trace_annotations_enabled,
 )
 from spark_ensemble_tpu import robustness
@@ -218,9 +222,13 @@ __all__ = [
     "MinMaxScaler",
     "MinMaxScalerModel",
     "FitTelemetry",
+    "FlightRecorder",
     "MetricsRegistry",
     "TelemetryRecorder",
+    "dump_flight",
     "record_fits",
+    "skew_report",
+    "stitch_files",
     "Span",
     "TraceContext",
     "Tracer",

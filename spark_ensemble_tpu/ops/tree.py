@@ -1134,12 +1134,19 @@ def round_cost_est(
         2.0 * n * (M * 2**level * C) * (d * B) for level in range(max_depth)
     ) + 2.0 * n * M * 2**max_depth * C
     peak = 197e12 if jax.default_backend() == "tpu" else 1e12
+    # nominal HBM bandwidth paired with peak_flops: the roofline's other
+    # axis, so telemetry can model round time as max(flops/peak,
+    # bytes/bw) and report cost_model_error_pct against the measured
+    # duration (v5p-class HBM; CPU placeholder mirrors the peak_flops
+    # convention above)
+    bw = 1.23e12 if jax.default_backend() == "tpu" else 5e10
     return {
         "hist_tier": tier,
         "pack_bits": bits,
         "hbm_bytes_est": int(hbm),
         "flops_est": float(flops),
         "peak_flops": float(peak),
+        "hbm_bw_est": float(bw),
     }
 
 

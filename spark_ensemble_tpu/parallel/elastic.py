@@ -54,8 +54,10 @@ devices.
 
 from __future__ import annotations
 
+import itertools
 import os
 import time
+import zlib
 from typing import List, Tuple
 
 import jax
@@ -84,8 +86,55 @@ from spark_ensemble_tpu.parallel.mesh import (
     mesh_row_spec,
 )
 from spark_ensemble_tpu.robustness.chaos import ChaosHostPreemption
+from spark_ensemble_tpu.telemetry.events import global_metrics
+from spark_ensemble_tpu.telemetry.flight import dump_flight
 
 REDUCE_MODES = ("ordered", "psum")
+
+
+def preempt_flow_id(victim: int, site: str) -> int:
+    """Trace-flow id tying a ``host_preempt`` span to the ``rewind``
+    span of the attempt that absorbs it.  Deliberately NOT
+    ``trace.new_flow_id()`` (pid-local): the preemption verdict is the
+    same pure function of ``(victim, site)`` on every host, so deriving
+    the id from it gives every process the SAME id without
+    communicating — which is what lets ``telemetry/podview.py`` stitch
+    the victim's flow_out to the survivor's flow_in across streams."""
+    return zlib.crc32(f"host_preempt:{victim}:{site}".encode()) & 0x7FFFFFFF
+
+
+#: flow id of the preemption this process must acknowledge on its next
+#: distributed attempt (set when raising, consumed by the next
+#: DistributedSweep so the rewind span carries the matching flow_in)
+_PENDING_REWIND_FLOW: List[int] = []
+
+
+def consume_rewind_flow() -> int:
+    """Pop the pending preemption flow id (0 when none): called by every
+    ``DistributedSweep.__init__`` so a stale id never leaks into an
+    unrelated fit."""
+    if _PENDING_REWIND_FLOW:
+        fid = _PENDING_REWIND_FLOW[-1]
+        _PENDING_REWIND_FLOW.clear()
+        return fid
+    return 0
+
+
+def _site_round(site: str) -> int:
+    """The stream-round index out of a sweep site string
+    (``"{family}:stream_round:{r}:..."``; -1 when absent) — attached to
+    the dist sweep spans so skew attribution is per-round."""
+    marker = "stream_round:"
+    i = site.find(marker)
+    if i < 0:
+        return -1
+    digits = ""
+    for ch in site[i + len(marker):]:
+        if ch.isdigit():
+            digits += ch
+        else:
+            break
+    return int(digits) if digits else -1
 
 #: env flag: block around every reduce dispatch and accumulate its wall
 #: share (bench.py's dcn_reduce_share metric).  Off by default — the
@@ -205,12 +254,23 @@ class DistributedSweep:
         self.measure = os.environ.get(_MEASURE_ENV, "") == "1"
         self.reduce_s = 0.0
         self.sweep_s = 0.0
+        rewind_fid = consume_rewind_flow()
         if telem is not None:
             telem.emit(
                 "dist_config", hosts=self.num_hosts, positions=self.W,
                 steps=self.K, shards=self.S, reduce=reduce,
                 process=pidx,
             )
+            if rewind_fid:
+                # this attempt absorbs a preemption: an instant span
+                # whose flow_in matches the victim's host_preempt
+                # flow_out (preempt_flow_id is host-symmetric), so the
+                # viewer draws the rewind arrow — across hosts once the
+                # streams are stitched (telemetry/podview.py)
+                telem.emit_span(
+                    "rewind", time.time(), 0.0, thread=f"host{pidx}",
+                    flow_in=rewind_fid,
+                )
 
     # -- manifest agreement ------------------------------------------------
 
@@ -547,7 +607,13 @@ class DistributedSweep:
 
     def _maybe_preempt(self, ctl, site: str, *pending):
         """Chaos seam: symmetric deterministic verdict, drain, then
-        victim/survivor-specific raise (see chaos.host_preempt)."""
+        victim/survivor-specific raise (see chaos.host_preempt).
+
+        Before raising, this is the flush-on-crash chokepoint
+        (docs/tracing.md#pod-scope): the victim's buffered telemetry is
+        fsync'd to its JSONL sink and the flight-recorder ring is dumped
+        — a preempted process may be SIGKILLed the moment it leaves the
+        rendezvous, and the black box must already be on disk."""
         hook = getattr(ctl, "host_preempt", None)
         if hook is None or not hook(site):
             return
@@ -556,13 +622,54 @@ class DistributedSweep:
         # flight, or the survivors hang inside XLA instead of rewinding
         # graftlint: ignore[unfenced-blocking-read] -- preemption teardown path; the fit is being abandoned, there is no dispatch pipeline left to charge the wait to
         jax.block_until_ready([p for p in pending if p is not None])
+        fid = preempt_flow_id(victim, site)
         if self.telem is not None:
             self.telem.emit("host_preempted", victim=victim, site=site)
+            if jax.process_count() == 1 or victim == jax.process_index():
+                # the flow SOURCE is victim-only in multi-process mode:
+                # a survivor's standalone stream must fail --validate on
+                # the rewind's unresolved flow_in, proving the pod view
+                # is needed — stitching restores the arrow
+                self.telem.emit_span(
+                    "host_preempt", time.time(), 0.0,
+                    thread=f"host{jax.process_index()}",
+                    flow_out=[fid], victim=victim, site=site,
+                )
+            self.telem.flush(fsync=True)
+        _PENDING_REWIND_FLOW.clear()
+        _PENDING_REWIND_FLOW.append(fid)
+        dump_flight(
+            reason="host_preempt",
+            telemetry_path=getattr(self.telem, "_path", None),
+            extra={"victim": victim, "site": site,
+                   "process_index": jax.process_index()},
+        )
         if jax.process_count() > 1 and victim == jax.process_index():
             raise ChaosHostPreemption(
                 f"chaos: host {victim} preempted at {site}"
             )
         raise HostLostError(victim, site)
+
+    def _maybe_stall(self, ctl, site: str) -> None:
+        """Straggler chaos seam: the ``host_stall`` verdict is symmetric
+        (same pure draw on every host) but only the picked victim
+        sleeps, dragging its sweep step — the skew the pod report
+        (telemetry/podview.py ``skew_report``) must attribute."""
+        hook = getattr(ctl, "host_stall_s", None)
+        if hook is None:
+            return
+        seconds = hook(site)
+        if seconds <= 0:
+            return
+        victim = ctl.pick("host_stall", site, self.num_hosts)
+        if jax.process_count() > 1 and victim != jax.process_index():
+            return  # peers saw the same draw; only the victim drags
+        if self.telem is not None:
+            self.telem.emit(
+                "host_stalled", victim=victim, site=site,
+                seconds=float(seconds),
+            )
+        time.sleep(seconds)
 
     def sweep_forest(self, prefetch, ctl, site, vals_p, y_mean, mask,
                      thresholds, *, max_depth, B, bits, d, prec,
@@ -596,6 +703,7 @@ class DistributedSweep:
         bf_np = bt_np = None
         red = self._reduce_prog()
         thread = f"host{jax.process_index()}"
+        rnd = _site_round(site)
         for level in range(max_depth):
             t_lvl = time.time()
             t0 = time.perf_counter()
@@ -605,10 +713,9 @@ class DistributedSweep:
             )()
             sweep_iter = prefetch.sweep()
             for k in range(self.K):
-                self._maybe_preempt(
-                    ctl, f"{site}:level:{level}:dist_step:{k}",
-                    acc, node_w,
-                )
+                step_site = f"{site}:level:{level}:dist_step:{k}"
+                self._maybe_stall(ctl, step_site)
+                self._maybe_preempt(ctl, step_site, acc, node_w)
                 packed_w = self._collect_step(sweep_iter)
                 if level == 0:
                     contrib, node_w = prog(
@@ -623,9 +730,11 @@ class DistributedSweep:
             # replicated accumulator -> host-local operands for the
             # SHARED finish program (byte-identical to single-host)
             t_fetch0 = time.perf_counter()
+            steps_s = t_fetch0 - t0
             acc_h = jnp.asarray(self._fetch(acc))
+            fetch_s = time.perf_counter() - t_fetch0
             if self.telem is not None:
-                self.telem.host_blocked(time.perf_counter() - t_fetch0)
+                self.telem.host_blocked(fetch_s)
             fin = _level_finish_prog(level, B, d, prec, min_gain)
             best_f, best_t, parent_value, sf, sb, stt, sg = fin(
                 acc_h, mask, thresholds, parent_value, sf, sb, stt, sg
@@ -640,9 +749,14 @@ class DistributedSweep:
             dur = time.perf_counter() - t0
             if self.telem is not None:
                 self.telem.host_blocked(time.perf_counter() - t_fetch0)
+                # steps_s/fetch_s split the level wall at the blocking
+                # reduce fetch — the cross-host sync barrier podview
+                # estimates clock offsets at and skew_report attributes
+                # stragglers with (docs/tracing.md#pod-scope)
                 self.telem.emit_span(
                     f"dist_level_{level}", t_lvl, dur, thread=thread,
-                    steps=self.K,
+                    steps=self.K, steps_s=steps_s, fetch_s=fetch_s,
+                    round=rnd,
                 )
             self.sweep_s += dur
         t_lvl = time.time()
@@ -653,26 +767,29 @@ class DistributedSweep:
         )()
         sweep_iter = prefetch.sweep()
         for k in range(self.K):
-            self._maybe_preempt(
-                ctl, f"{site}:leaf:dist_step:{k}", acc, node_w
-            )
+            step_site = f"{site}:leaf:dist_step:{k}"
+            self._maybe_stall(ctl, step_site)
+            self._maybe_preempt(ctl, step_site, acc, node_w)
             packed_w = self._collect_step(sweep_iter)
             contrib, node_w = leaf(
                 packed_w, node_w, vals_w, np.int32(k), bf_np, bt_np
             )
             acc = self._run_reduce(red, acc, contrib)
         t_fetch0 = time.perf_counter()
+        steps_s = t_fetch0 - t0
         acc_h = jnp.asarray(self._fetch(acc))
         node_all = jnp.asarray(
             self._fetch(self._gather_nodes_prog()(node_w))
         )
+        fetch_s = time.perf_counter() - t_fetch0
         if self.telem is not None:
-            self.telem.host_blocked(time.perf_counter() - t_fetch0)
+            self.telem.host_blocked(fetch_s)
         leaf_value = _leaf_finish_prog()(acc_h, parent_value, y_mean)
         dur = time.perf_counter() - t0
         if self.telem is not None:
             self.telem.emit_span(
-                "dist_leaf", t_lvl, dur, thread=thread, steps=self.K
+                "dist_leaf", t_lvl, dur, thread=thread, steps=self.K,
+                steps_s=steps_s, fetch_s=fetch_s, round=rnd,
             )
         self.sweep_s += dur
         tree = Tree(
@@ -712,6 +829,9 @@ def _record_fit_stats(dist: DistributedSweep) -> None:
         )
 
 
+_COORD_SEQ = itertools.count()
+
+
 class ElasticCoordinator:
     """Detect -> drain -> repartition -> rewind -> resume.
 
@@ -740,23 +860,69 @@ class ElasticCoordinator:
         self.max_losses = int(max_losses)
         #: (victim, site, surviving_width) per absorbed preemption
         self.losses: List[Tuple[int, str, int]] = []
+        #: fit attempts entered (1 for an uninterrupted fit)
+        self.attempts = 0
+        self._t0 = time.time()
+        self._label = f"elastic:{os.getpid()}:{next(_COORD_SEQ)}"
+        self._source_name = f"elastic/{self._label}"
+
+    def statusz(self) -> dict:
+        """Live coordinator state, mirroring ``FleetRouter.statusz()``:
+        the current mesh shape, absorbed losses and attempt count, plus
+        the last distributed fit's sweep/reduce walls.  Registered as a
+        ``global_metrics()`` source for the duration of each
+        ``fit_streaming`` call, so a mid-fit snapshot (/statusz pages,
+        the flight-recorder dump) shows where the pod stands."""
+        width = 1
+        for a in mesh_row_axes(self.mesh):
+            width *= int(self.mesh.shape[a])
+        return {
+            "label": self._label,
+            "uptime_s": time.time() - self._t0,
+            "reduce": self.reduce,
+            "mesh_axes": {
+                a: int(self.mesh.shape[a]) for a in self.mesh.axis_names
+            },
+            "width": width,
+            "process_index": int(jax.process_index()),
+            "process_count": int(jax.process_count()),
+            "attempts": self.attempts,
+            "max_losses": self.max_losses,
+            "losses": [
+                {"victim": v, "site": s, "width": w}
+                for v, s, w in self.losses
+            ],
+            "last_fit": last_fit_stats(),
+        }
 
     def fit_streaming(self, est, store, y, **kw):
         """Run ``est.fit_streaming(store, y, mesh=..., reduce=...)``
         to completion, absorbing up to ``max_losses`` host losses.
         Returns the fitted model; ``self.mesh`` ends as the mesh the
         fit actually finished on."""
-        while True:
-            try:
-                return est.fit_streaming(
-                    store, y, mesh=self.mesh, reduce=self.reduce, **kw
-                )
-            except HostLostError as e:
-                if len(self.losses) >= self.max_losses:
-                    raise
-                self.mesh = survivor_mesh(self.mesh, e.victim)
-                width = int(np.prod([
-                    self.mesh.shape[a]
-                    for a in mesh_row_axes(self.mesh)
-                ]))
-                self.losses.append((e.victim, e.site, width))
+        metrics = global_metrics()
+        metrics.register_source(self._source_name, self.statusz)
+        # drop any stale preemption flow left by an ABANDONED fit (a
+        # loss over max_losses re-raises with the id still pending) —
+        # a fresh fit's first attempt is not a resume and must not emit
+        # a phantom rewind span
+        consume_rewind_flow()
+        try:
+            while True:
+                self.attempts += 1
+                try:
+                    return est.fit_streaming(
+                        store, y, mesh=self.mesh, reduce=self.reduce, **kw
+                    )
+                except HostLostError as e:
+                    if len(self.losses) >= self.max_losses:
+                        raise
+                    self.mesh = survivor_mesh(self.mesh, e.victim)
+                    width = int(np.prod([
+                        self.mesh.shape[a]
+                        for a in mesh_row_axes(self.mesh)
+                    ]))
+                    self.losses.append((e.victim, e.site, width))
+        finally:
+            metrics.unregister_source(self._source_name)
+            consume_rewind_flow()  # never leak into a later fit

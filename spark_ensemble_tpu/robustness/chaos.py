@@ -13,7 +13,7 @@ Environment contract (read once, cached):
   are hashed to one).  Unset/empty → no-op controller.
 - ``SE_TPU_CHAOS_FAULTS``: comma list restricting the active fault kinds
   (subset of ``nan_grad,preempt,transient,ckpt_corrupt,replica_stall,
-  replica_crash,slow_reply``; default all).
+  replica_crash,slow_reply,host_preempt,host_stall``; default all).
 - ``SE_TPU_CHAOS_RATE``: per-site firing probability (default 0.05).
 - ``SE_TPU_CHAOS_LOG``: JSONL path appending one record per injected fault
   (uploaded as a CI artifact next to the telemetry stream).
@@ -40,9 +40,12 @@ FAULT_KINDS = (
     "nan_grad", "preempt", "transient", "ckpt_corrupt",
     # serving-fleet faults (fired from FleetRouter replica workers only)
     "replica_stall", "replica_crash", "slow_reply",
-    # elastic-training fault (fired from the distributed sweep only):
-    # kills one whole host mid-round; survivors repartition and resume
+    # elastic-training faults (fired from the distributed sweep only):
+    # host_preempt kills one whole host mid-round (survivors repartition
+    # and resume); host_stall makes one host drag a sweep step — the
+    # straggler the pod skew report must attribute (telemetry/podview.py)
     "host_preempt",
+    "host_stall",
 )
 
 
@@ -242,6 +245,16 @@ class ChaosController:
         same verdict at the same site without communicating."""
         return self._fire("host_preempt", site)
 
+    def host_stall_s(self, site: str, seconds: float = 0.25) -> float:
+        """Seconds one host should drag a distributed sweep step —
+        enough to dominate the per-round sweep wall so the pod skew
+        report names the straggler deterministically, without tripping
+        anything fatal.  Like :meth:`host_preempt` the verdict is
+        symmetric (pure function of seed/fault/site); the caller
+        resolves WHICH host sleeps via :meth:`pick` and only the victim
+        does.  0.0 when the site does not fire."""
+        return float(seconds) if self._fire("host_stall", site) else 0.0
+
     # -- serving-fleet hooks (called from FleetRouter replica workers) -----
 
     def stall_s(self, site: str, seconds: float = 0.25) -> float:
@@ -292,6 +305,9 @@ class _NoopController:
 
     def host_preempt(self, site: str) -> bool:
         return False
+
+    def host_stall_s(self, site: str, seconds: float = 0.25) -> float:
+        return 0.0
 
     def crash(self, site: str) -> None:
         pass

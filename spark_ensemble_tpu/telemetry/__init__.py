@@ -4,7 +4,22 @@ See ``docs/telemetry.md`` for how to enable the JSONL sink
 (``SE_TPU_TELEMETRY`` / the ``telemetry_path`` param), the event schema,
 and ``tools/telemetry_report.py`` for rendering streams into the same
 per-phase cost table ``utils/profiling.py`` produces from profiler traces.
+Pod scope (``docs/tracing.md#pod-scope``): ``podview`` stitches per-host
+streams into one pod trace and folds straggler skew; ``flight`` keeps the
+per-process crash ring dumped on preemption.
 """
+
+from spark_ensemble_tpu.telemetry.flight import (
+    FlightRecorder,
+    dump_flight,
+    flight_dump_path,
+)
+from spark_ensemble_tpu.telemetry.podview import (
+    estimate_offsets,
+    skew_report,
+    stitch,
+    stitch_files,
+)
 
 from spark_ensemble_tpu.telemetry.registry import (
     Counter,
@@ -58,4 +73,11 @@ __all__ = [
     "new_span_id",
     "new_flow_id",
     "trace_annotations_enabled",
+    "FlightRecorder",
+    "dump_flight",
+    "flight_dump_path",
+    "estimate_offsets",
+    "skew_report",
+    "stitch",
+    "stitch_files",
 ]

@@ -43,6 +43,7 @@ from typing import Any, Dict, Iterator, List, Optional
 
 import numpy as np
 
+from spark_ensemble_tpu.telemetry import flight as _flight
 from spark_ensemble_tpu.telemetry.registry import MetricsRegistry
 from spark_ensemble_tpu.telemetry.trace import (
     NULL_CONTEXT,
@@ -263,12 +264,19 @@ def _active_recorder() -> Optional[TelemetryRecorder]:
 _JSONL_LOCK = threading.Lock()
 
 
-def _append_jsonl(path: str, events: List[Dict[str, Any]]) -> None:
+def _append_jsonl(path: str, events: List[Dict[str, Any]],
+                  fsync: bool = False) -> None:
     lines = [json.dumps(ev, sort_keys=True, default=float) for ev in events]
     with _JSONL_LOCK:
         with open(path, "a") as f:
             for line in lines:
                 f.write(line + "\n")
+            if fsync:
+                # crash paths (host_preempt, abort) must not lose the
+                # terminal rows to page-cache buffering: the victim is
+                # about to re-raise and may be SIGKILLed mid-teardown
+                f.flush()
+                os.fsync(f.fileno())
 
 
 # ---------------------------------------------------------------------------
@@ -309,6 +317,7 @@ def emit_event(event: str, path: Optional[str] = None, **fields) -> None:
     ev: Dict[str, Any] = {"event": event, "ts": time.time()}
     ev.update(fields)
     ev.setdefault("fit_id", "serving")
+    _flight.recorder().record(ev)
     if recorder is not None:
         recorder.record(ev)
     if path:
@@ -353,6 +362,12 @@ class FitTelemetry:
         self._tracer = Tracer(self._emit, trace_id=self.trace_id)
         _ensure_compile_listener()
         self._compile0 = compile_snapshot()
+        # incremental JSONL flush cursor (flush-on-crash support: the
+        # host_preempt path flushes mid-fit; finish()/abort() flush the
+        # remainder) and the measured-vs-estimated ledger baselines
+        self._flushed = 0
+        self._ledger_compile = self._compile0
+        self._ledger_mem: Dict[str, int] = {}
 
     # -- construction -----------------------------------------------------
 
@@ -396,6 +411,7 @@ class FitTelemetry:
         event.setdefault("ts", time.time())
         with self._lock:
             self._events.append(event)
+        _flight.recorder().record(event)
         if self._recorder is not None:
             self._recorder.record(event)
 
@@ -437,6 +453,21 @@ class FitTelemetry:
         t0 = time.perf_counter()
         block_on_arrays(fence)
         self.host_blocked(time.perf_counter() - t0)
+
+    def flush(self, fsync: bool = False) -> int:
+        """Append events emitted since the last flush to the JSONL sink
+        (no-op without one); returns the row count written.  Crash paths
+        pass ``fsync=True`` so the stream survives the process dying
+        right after — the victim's half of a preemption would otherwise
+        sit in the page cache when SIGKILL lands (docs/tracing.md)."""
+        if not self._path:
+            return 0
+        with self._lock:
+            pending = self._events[self._flushed:]
+            self._flushed = len(self._events)
+        if pending:
+            _append_jsonl(self._path, pending, fsync=fsync)
+        return len(pending)
 
     # -- causal tracing (telemetry/trace.py; docs/tracing.md) -------------
 
@@ -501,7 +532,17 @@ class FitTelemetry:
         ``pack_bits``, ``hbm_bytes_est`` — and, combined with the measured
         per-round duration, a per-round ``mfu_est`` (flops_est /
         (duration * peak_flops)), so MFU is observable per fit instead of
-        only in one-off captures."""
+        only in one-off captures.
+
+        Measured-vs-estimated ledger (docs/tracing.md#pod-scope): each
+        chunk also records what the devices actually did against what
+        the cost model predicted — the compile-count delta and
+        per-device ``bytes_in_use`` delta since the previous chunk land
+        on the chunk's first ``round_end`` (``chunk_compiles`` /
+        ``chunk_compile_s`` / ``memory_delta``), and when the cost model
+        supplies ``hbm_bw_est`` the roofline time ``modeled_s =
+        max(flops/peak, hbm_bytes/bw)`` is compared against the measured
+        per-round duration as ``cost_model_error_pct``."""
         if fence is not None and fence != ():
             block_on_arrays(fence)
         now = time.perf_counter()
@@ -513,6 +554,17 @@ class FitTelemetry:
             step_arr = np.asarray(step_sizes, dtype=np.float64)
             step_arr = step_arr.reshape(step_arr.shape[0], -1).mean(axis=1)
         mem = device_memory_stats()
+        c1, s1 = compile_snapshot()
+        chunk_compiles = c1 - self._ledger_compile[0]
+        chunk_compile_s = s1 - self._ledger_compile[1]
+        self._ledger_compile = (c1, s1)
+        mem_delta: Dict[str, int] = {}
+        for dev, stats in mem.items():
+            cur = int(stats.get("bytes_in_use", 0))
+            prev = self._ledger_mem.get(dev)
+            if prev is not None and cur != prev:
+                mem_delta[dev] = cur - prev
+            self._ledger_mem[dev] = cur
         cost_fields: Dict[str, Any] = {}
         if round_cost:
             for key in ("hist_tier", "pack_bits", "hbm_bytes_est"):
@@ -522,6 +574,17 @@ class FitTelemetry:
             peak = round_cost.get("peak_flops")
             if flops and peak and per_round > 0:
                 cost_fields["mfu_est"] = float(flops) / (per_round * float(peak))
+                modeled = float(flops) / float(peak)
+                bw = round_cost.get("hbm_bw_est")
+                if bw:
+                    modeled = max(
+                        modeled,
+                        float(round_cost.get("hbm_bytes_est", 0.0)) / float(bw),
+                    )
+                cost_fields["modeled_s"] = modeled
+                cost_fields["cost_model_error_pct"] = (
+                    100.0 * abs(per_round - modeled) / per_round
+                )
         for j in range(count):
             rnd = start_round + j
             li = rnd if learner_index is None else learner_index
@@ -535,6 +598,14 @@ class FitTelemetry:
                 "phases": {"device_round": per_round},
             }
             end_ev.update(cost_fields)
+            if j == 0:
+                # the ledger deltas are chunk-granular (one dispatch);
+                # charging them to every synthesized round would
+                # overcount, so they ride the chunk's first round only
+                end_ev["chunk_compiles"] = chunk_compiles
+                end_ev["chunk_compile_s"] = chunk_compile_s
+                if mem_delta:
+                    end_ev["memory_delta"] = mem_delta
             if loss_arr is not None and j < loss_arr.shape[0]:
                 end_ev["loss"] = float(loss_arr[j])
             if step_arr is not None and j < step_arr.shape[0]:
@@ -623,10 +694,7 @@ class FitTelemetry:
         ev.update(outcome)
         self._emit_root_span(wall, rounds=self._rounds)
         self._emit(ev)
-        if self._path:
-            with self._lock:
-                events = list(self._events)
-            _append_jsonl(self._path, events)
+        self.flush()
         if model is not None:
             model.fit_history_ = self.history()
 
@@ -655,10 +723,9 @@ class FitTelemetry:
         ev.update(outcome)
         self._emit_root_span(wall, error=type(error).__name__)
         self._emit(ev)
-        if self._path:
-            with self._lock:
-                events = list(self._events)
-            _append_jsonl(self._path, events)
+        # fsync: abort runs on crash paths (preemption, guard abort)
+        # where the process may be killed before the page cache drains
+        self.flush(fsync=True)
 
     def _unregister(self) -> None:
         st = _stack()
@@ -761,6 +828,9 @@ class _DisabledFitTelemetry(FitTelemetry):
     def round_chunk(self, *a, **kw):
         return 0.0
 
+    def flush(self, fsync=False):
+        return 0
+
     def host_blocked(self, seconds):
         pass
 
@@ -824,11 +894,22 @@ def active_fit_depth() -> int:
 
 def abort_active_fits(depth: int, error: BaseException) -> None:
     """Abort (emit ``fit_aborted`` + flush) every telemetry registered on
-    this thread above ``depth``, innermost first."""
+    this thread above ``depth``, innermost first; then leave a flight-
+    recorder dump — guard aborts and host losses are exactly the deaths
+    the black box exists for (telemetry/flight.py)."""
     st = _stack()
+    path = None
+    aborted = False
     while len(st) > depth:
         telem = st.pop()
+        aborted = True
+        path = path or getattr(telem, "_path", None)
         try:
             telem.abort(error)
         except Exception:
             logger.exception("failed to flush fit_aborted record")
+    if aborted:
+        _flight.dump_flight(
+            reason=f"fit_abort:{type(error).__name__}", error=error,
+            telemetry_path=path,
+        )

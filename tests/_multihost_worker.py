@@ -127,14 +127,21 @@ def _run_dist(pid, workdir) -> int:
 
 
 class _HostPreemptAt:
-    """Deterministic single-shot host_preempt at one site, pinned victim."""
+    """Deterministic single-shot host_preempt at one site, pinned victim;
+    optionally also a single-shot host_stall at another site (the skew
+    report must name the stalled host)."""
 
     enabled = True
 
-    def __init__(self, site, victim):
+    def __init__(self, site, victim, stall_site=None, stall_victim=0,
+                 stall_s=0.4):
         self.site = site
         self.victim = victim
+        self.stall_site = stall_site
+        self.stall_victim = stall_victim
+        self.stall_s = stall_s
         self.fired = []
+        self.stalled = []
 
     def host_preempt(self, site):
         if site == self.site and not self.fired:
@@ -142,7 +149,17 @@ class _HostPreemptAt:
             return True
         return False
 
+    def host_stall_s(self, site, seconds=0.25):
+        # verdict is site-deterministic, so every process agrees without
+        # communicating; only the picked victim actually sleeps
+        if site == self.stall_site and not self.stalled:
+            self.stalled.append(site)
+            return self.stall_s
+        return 0.0
+
     def pick(self, fault, site, n):
+        if fault == "host_stall":
+            return self.stall_victim % n
         return self.victim % n
 
     def preempt(self, site):
@@ -180,15 +197,32 @@ def _run_elastic(pid, workdir) -> int:
     done = os.path.join(workdir, "elastic.done")
 
     site = "GBMRegressor:stream_round:2:level:1:dist_step:1"
-    chaos.install(_HostPreemptAt(site, victim=1))
+    # host 0 also stalls once in round 1 (before the preemption round):
+    # the pod skew report must attribute that round to host 0
+    chaos.install(_HostPreemptAt(
+        site, victim=1,
+        stall_site="GBMRegressor:stream_round:1:level:0:dist_step:0",
+        stall_victim=0,
+    ))
     coord = ElasticCoordinator(mesh)
     try:
         model = coord.fit_streaming(
             _streaming_reg(os.path.join(workdir, f"ck{pid}")), store, y
         )
     except ChaosHostPreemption:
-        # this process IS the preempted host: park until the survivor
-        # finishes (exiting would tear down the coordination service)
+        # this process IS the preempted host: the crash flight recorder
+        # must already have landed next to the telemetry stream (the
+        # preempt path dumps + fsyncs BEFORE re-raising)
+        import json
+
+        fl = os.path.join(workdir, f"flight_p{os.getpid()}.json")
+        with open(fl) as f:
+            payload = json.load(f)
+        assert payload["rows"], payload
+        assert payload["recorded"] > 0
+        print("FLIGHT_OK", flush=True)
+        # park until the survivor finishes (exiting would tear down the
+        # coordination service)
         print("PREEMPTED", flush=True)
         _await_file(done)
         print("PREEMPT_EXIT_OK", flush=True)

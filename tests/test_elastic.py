@@ -497,3 +497,164 @@ def test_elastic_coordinator_respects_max_losses(tmp_path):
     with pytest.raises(HostLostError):
         coord.fit_streaming(_reg(), store, y)
     assert coord.losses == []
+
+
+# ---------------------------------------------------------------------------
+# pod-scope observability: preempt/rewind flows, flight dump, statusz, stalls
+# ---------------------------------------------------------------------------
+
+
+def test_preempt_rewind_flow_and_flight_dump(tmp_path, monkeypatch):
+    """The preemption leaves a complete causal record: a ``host_preempt``
+    span whose deterministic flow id the resuming attempt's ``rewind``
+    span consumes (the single-process stream validates clean on its own),
+    the stream fsync'd BEFORE the raise, and the crash flight dump on
+    disk next to it."""
+    import importlib.util
+    import json
+    import os
+
+    from spark_ensemble_tpu.parallel.elastic import preempt_flow_id
+
+    tel = tmp_path / "telemetry.jsonl"
+    monkeypatch.setenv("SE_TPU_TELEMETRY", str(tel))
+    X, y = _data()
+    store = _store(tmp_path, X, shard_rows=32)
+    site = "GBMRegressor:stream_round:2:level:1:dist_step:1"
+    chaos.install(_HostPreemptAt(site, victim=1))
+    coord = ElasticCoordinator(data_member_mesh(4, member=1))
+    coord.fit_streaming(_reg(str(tmp_path / "ck")), store, y)
+
+    events = [json.loads(line) for line in open(tel)]
+    spans = [e for e in events if e.get("event") == "span"]
+    fid = preempt_flow_id(1, site)
+    preempts = [s for s in spans if s["name"] == "host_preempt"]
+    rewinds = [s for s in spans if s["name"] == "rewind"]
+    assert len(preempts) == 1 and preempts[0]["flow_out"] == [fid]
+    assert preempts[0]["victim"] == 1 and preempts[0]["site"] == site
+    assert len(rewinds) == 1 and rewinds[0]["flow_in"] == fid
+    # single-process: source and sink live in ONE stream -> clean graph
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "_viewer", os.path.join(repo, "tools", "trace_viewer.py")
+    )
+    viewer = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(viewer)
+    assert viewer.validate(viewer.select_spans(events)) == []
+    # the black box landed next to the stream before the raise
+    dump = tmp_path / f"flight_p{os.getpid()}.json"
+    assert dump.exists()
+    payload = json.loads(dump.read_text())
+    assert payload["rows"]
+    assert any(
+        r.get("event") == "host_preempted" for r in payload["rows"]
+    )
+
+
+def test_flight_dir_env_overrides_stream_location(tmp_path, monkeypatch):
+    import json
+    import os
+
+    box = tmp_path / "blackbox"
+    monkeypatch.setenv("SE_TPU_TELEMETRY", str(tmp_path / "t.jsonl"))
+    monkeypatch.setenv("SE_TPU_FLIGHT_DIR", str(box))
+    X, y = _data(n=128)
+    store = _store(tmp_path, X, shard_rows=32)
+    site = "GBMRegressor:stream_round:1:level:0:dist_step:0"
+    chaos.install(_HostPreemptAt(site, victim=2))
+    coord = ElasticCoordinator(data_member_mesh(4, member=1))
+    coord.fit_streaming(_reg(str(tmp_path / "ck")), store, y)
+    dump = box / f"flight_p{os.getpid()}.json"
+    assert dump.exists()
+    assert json.loads(dump.read_text())["rows"]
+
+
+def test_coordinator_statusz_counts_attempts_and_losses(tmp_path):
+    from spark_ensemble_tpu.telemetry.events import global_metrics
+
+    X, y = _data()
+    store = _store(tmp_path, X, shard_rows=32)
+    site = "GBMRegressor:stream_round:2:level:1:dist_step:1"
+
+    seen = {}
+
+    class _Snooping(_HostPreemptAt):
+        """Grab the live metrics snapshot from INSIDE the fit — the
+        coordinator's statusz source must be visible mid-flight."""
+
+        def host_preempt(self, site_):
+            if site_ == self.site and not self.fired:
+                seen.update(global_metrics().snapshot())
+            return _HostPreemptAt.host_preempt(self, site_)
+
+    chaos.install(_Snooping(site, victim=1))
+    coord = ElasticCoordinator(data_member_mesh(4, member=1))
+    assert coord.statusz()["attempts"] == 0
+    coord.fit_streaming(_reg(str(tmp_path / "ck")), store, y)
+
+    sz = coord.statusz()
+    assert sz["attempts"] == 2  # initial + resumed
+    assert sz["width"] == 3  # survivors after the loss
+    # the recorded width is the SURVIVOR width the fit resumed on
+    assert sz["losses"] == [{"victim": 1, "site": site, "width": 3}]
+    assert sz["process_count"] == 1 and sz["uptime_s"] >= 0.0
+    assert sz["last_fit"]["sweep_s"] >= 0.0
+    # the source was registered while fitting...
+    mid = seen.get(coord._source_name)
+    assert mid is not None and mid["value"]["attempts"] >= 1
+    # ...and unregistered after
+    assert coord._source_name not in global_metrics().snapshot()
+
+
+def test_chaos_host_stall_verdict_and_noop():
+    ctl = chaos.ChaosController(seed=5, rate=1.0, faults=("host_stall",))
+    s = ctl.host_stall_s("fit:level:0:dist_step:0", seconds=0.05)
+    assert s == 0.05
+    # at-most-once per site, and the pick is deterministic
+    assert ctl.host_stall_s("fit:level:0:dist_step:0") == 0.0
+    assert ctl.pick("host_stall", "s", 4) == chaos.ChaosController(
+        seed=5, rate=1.0
+    ).pick("host_stall", "s", 4)
+    assert chaos._NoopController().host_stall_s("x") == 0.0
+
+
+def test_single_process_stall_attribution(tmp_path):
+    """An injected host_stall on a simulated host must surface as an
+    attributable ``host_stalled`` event, and the skew report must name
+    the victim."""
+    from spark_ensemble_tpu.telemetry import podview
+
+    X, y = _data(n=128)
+    store = _store(tmp_path, X, shard_rows=32)
+    stall_site = "GBMRegressor:stream_round:1:level:0:dist_step:0"
+
+    class _StallOnce(_HostPreemptAt):
+        def __init__(self):
+            _HostPreemptAt.__init__(self, site="", victim=0)
+            self.stalled = []
+
+        def host_preempt(self, site):
+            return False
+
+        def host_stall_s(self, site, seconds=0.25):
+            if site == stall_site and not self.stalled:
+                self.stalled.append(site)
+                return 0.05
+            return 0.0
+
+        def pick(self, fault, site, n):
+            return 2 % n
+
+    ctl = _StallOnce()
+    chaos.install(ctl)
+    mesh = data_member_mesh(4, member=1)
+    with record_fits() as rec:
+        _reg().fit_streaming(store, y, mesh=mesh)
+    assert ctl.stalled == [stall_site]
+    stalled = [e for e in rec.events if e["event"] == "host_stalled"]
+    assert len(stalled) == 1
+    assert stalled[0]["victim"] == 2
+    assert stalled[0]["seconds"] == 0.05
+    report = podview.skew_report([rec.events])
+    assert report["stalls"] == {"2": {"count": 1, "seconds": 0.05}}
+    assert "stalls: host 2" in podview.render_skew(report)

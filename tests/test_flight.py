@@ -1,0 +1,138 @@
+"""Crash flight recorder (telemetry/flight.py): the per-process ring of
+last-K telemetry rows and its post-mortem dump.  The kill-and-inspect
+test is the bug-class regression for flush-on-crash: a subprocess emits,
+fsyncs, dumps, then SIGKILLs itself mid-flight — the parent must find a
+complete JSONL stream and an intact black box on disk."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from spark_ensemble_tpu.telemetry import flight
+from spark_ensemble_tpu.telemetry.events import emit_event, record_fits
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_ring_keeps_last_k_in_order():
+    rec = flight.FlightRecorder(capacity=3)
+    assert rec.rows() == [] and rec.recorded == 0
+    for i in range(5):
+        rec.record({"i": i})
+    assert rec.rows() == [{"i": 2}, {"i": 3}, {"i": 4}]
+    assert rec.recorded == 5
+    rec.clear()
+    assert rec.rows() == [] and rec.recorded == 0
+
+
+def test_ring_under_capacity_keeps_all():
+    rec = flight.FlightRecorder(capacity=8)
+    rec.record({"i": 0})
+    rec.record({"i": 1})
+    assert rec.rows() == [{"i": 0}, {"i": 1}]
+
+
+def test_capacity_must_be_positive():
+    with pytest.raises(ValueError):
+        flight.FlightRecorder(capacity=0)
+
+
+def test_dump_payload_and_atomicity(tmp_path):
+    rec = flight.FlightRecorder(capacity=4)
+    rec.record({"event": "span", "name": "x"})
+    out = str(tmp_path / "box.json")
+    got = rec.dump(out, reason="test", error=ValueError("boom"),
+                   extra={"victim": 1})
+    assert got == out
+    payload = json.loads(open(out).read())
+    assert payload["kind"] == "flight_recorder"
+    assert payload["reason"] == "test"
+    assert payload["pid"] == os.getpid()
+    assert payload["rows"] == [{"event": "span", "name": "x"}]
+    assert payload["recorded"] == 1
+    assert payload["error_type"] == "ValueError"
+    assert payload["error"] == "boom"
+    assert payload["victim"] == 1
+    # jax is importable here, so the dump carries the memory snapshot
+    assert "memory" in payload
+    assert not list(tmp_path.glob("*.tmp.*"))  # renamed, not left behind
+
+
+def test_dump_path_resolution(tmp_path, monkeypatch):
+    monkeypatch.delenv(flight.FLIGHT_DIR_ENV, raising=False)
+    monkeypatch.delenv("SE_TPU_TELEMETRY", raising=False)
+    # nothing resolves -> no dump, and dump_flight degrades to None
+    assert flight.flight_dump_path() is None
+    assert flight.dump_flight(reason="nowhere") is None
+    # next to an explicit telemetry stream
+    tel = tmp_path / "t" / "fit.jsonl"
+    p = flight.flight_dump_path(str(tel))
+    assert p == str(tmp_path / "t" / f"flight_p{os.getpid()}.json")
+    # the env stream works the same
+    monkeypatch.setenv("SE_TPU_TELEMETRY", str(tel))
+    assert flight.flight_dump_path() == p
+    # SE_TPU_FLIGHT_DIR beats both
+    monkeypatch.setenv(flight.FLIGHT_DIR_ENV, str(tmp_path / "box"))
+    assert flight.flight_dump_path(str(tel)) == str(
+        tmp_path / "box" / f"flight_p{os.getpid()}.json"
+    )
+
+
+def test_emit_chokepoints_feed_the_ring():
+    rec = flight.recorder()
+    before = rec.recorded
+    with record_fits():
+        emit_event("flight_probe", marker=123)
+    assert rec.recorded == before + 1
+    assert rec.rows()[-1]["event"] == "flight_probe"
+    assert rec.rows()[-1]["marker"] == 123
+
+
+def test_no_sink_records_nothing():
+    """The disabled path stays allocation-free: with no sink active,
+    emit_event returns before touching the ring."""
+    before = flight.recorder().recorded
+    emit_event("flight_probe_unsunk", marker=456)
+    assert flight.recorder().recorded == before
+
+
+_KILL_SCRIPT = """
+import os, signal, sys
+sys.path.insert(0, {repo!r})
+from spark_ensemble_tpu.telemetry.events import FitTelemetry
+from spark_ensemble_tpu.telemetry.flight import dump_flight
+
+telem = FitTelemetry.start(family="victim", n=10, d=2,
+                           telemetry_path={tel!r})
+for i in range(5):
+    telem.emit("probe", i=i)
+telem.flush(fsync=True)
+dump_flight(reason="about_to_die", telemetry_path={tel!r})
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+
+@pytest.mark.slow
+def test_kill_and_inspect(tmp_path):
+    """The preemption contract end-to-end: everything the flush-on-crash
+    chokepoint wrote must be readable AFTER an uncatchable SIGKILL."""
+    tel = str(tmp_path / "victim.jsonl")
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         _KILL_SCRIPT.format(repo=_REPO, tel=tel)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+    # the stream is complete JSONL: every line parses, the probes landed
+    events = [json.loads(line) for line in open(tel)]
+    assert sum(e.get("event") == "probe" for e in events) == 5
+    dumps = list(tmp_path.glob("flight_p*.json"))
+    assert len(dumps) == 1
+    payload = json.loads(dumps[0].read_text())
+    assert payload["reason"] == "about_to_die"
+    assert any(r.get("event") == "probe" for r in payload["rows"])

@@ -84,8 +84,68 @@ def test_two_process_distributed_histograms(tmp_path):
 @pytest.mark.slow
 def test_two_process_elastic_preempt_resume(tmp_path):
     """Process 1 dies to a live host_preempt mid-round; process 0 rewinds,
-    repartitions the orphaned slice, and resumes bit-identically."""
+    repartitions the orphaned slice, and resumes bit-identically.  The
+    two per-host telemetry streams must then stitch into one pod trace:
+    the survivor's stream ALONE fails validation (its rewind flow arrow
+    has no source), the stitched trace passes with host0/host1 tracks
+    and the preempt->rewind flow crossing hosts, the skew report names
+    the stalled host, and the victim's crash flight dump is on disk."""
+    import importlib.util
+    import json
+
     outs = _run_workers(("elastic", str(tmp_path)))
     assert "ELASTIC_OK" in outs[0], f"survivor incomplete:\n{outs[0][-3000:]}"
     assert "PREEMPTED" in outs[1], f"victim not preempted:\n{outs[1][-3000:]}"
     assert "PREEMPT_EXIT_OK" in outs[1], outs[1][-3000:]
+    assert "FLIGHT_OK" in outs[1], outs[1][-3000:]
+
+    spec = importlib.util.spec_from_file_location(
+        "_podview",
+        os.path.join(
+            _REPO, "spark_ensemble_tpu", "telemetry", "podview.py"
+        ),
+    )
+    podview = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(podview)
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        import trace_viewer
+    finally:
+        sys.path.pop(0)
+
+    paths = [str(tmp_path / f"telemetry_p{pid}.jsonl") for pid in (0, 1)]
+    streams = [podview.load_stream(p) for p in paths]
+
+    # the survivor alone is an INCOMPLETE trace: its rewind span's
+    # flow_in has no flow_out source (the victim emitted it)
+    survivor_spans = trace_viewer.select_spans(streams[0])
+    assert trace_viewer.validate(survivor_spans), (
+        "survivor-only stream unexpectedly validated clean"
+    )
+
+    # stitched, the pod trace is whole: validation passes, both hosts
+    # own tracks, and the preempt arrow lands in the survivor's rewind
+    merged, info = podview.stitch_files(paths)
+    assert info["hosts"] == [0, 1]
+    spans = trace_viewer.select_spans(merged)
+    assert trace_viewer.validate(spans) == []
+    threads = {s.get("thread", "") for s in spans}
+    assert any(t.startswith("host0") for t in threads), threads
+    assert any(t.startswith("host1") for t in threads), threads
+    preempts = [s for s in spans if s["name"] == "host_preempt"]
+    rewinds = [s for s in spans if s["name"] == "rewind"]
+    assert len(preempts) == 1 and len(rewinds) == 1
+    assert preempts[0]["host"] == 1 and rewinds[0]["host"] == 0
+    assert rewinds[0]["flow_in"] in preempts[0]["flow_out"]
+
+    # straggler attribution: the injected round-1 stall names host 0
+    skew = podview.skew_report(streams)
+    round1 = next(r for r in skew["rounds"] if r["round"] == 1)
+    assert round1["offender"] == 0, skew["rounds"]
+    assert "0" in skew["stalls"], skew["stalls"]
+
+    # the victim's flight dump carries its last spans/events
+    dumps = list(tmp_path.glob("flight_p*.json"))
+    assert dumps, list(tmp_path.iterdir())
+    payload = json.loads(max(dumps, key=lambda p: p.stat().st_size).read_text())
+    assert payload["rows"]

@@ -1,0 +1,238 @@
+"""Pod-scope trace stitching (telemetry/podview.py): clock-offset
+round-trips on synthetic two-host streams with KNOWN skew, stitch
+rewrite rules, cross-host flow resolution, and straggler attribution —
+plus multi-input CLI smokes for both tools."""
+
+import importlib.util
+import json
+import os
+import sys
+
+from spark_ensemble_tpu.telemetry import podview
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _host_stream(h, skew, steps_by_round, jitter=None, flow_out=None,
+                 flow_in=None, digest="d1", stalls=()):
+    """A synthetic per-host stream of one distributed fit: hosts cross
+    the same TRUE barrier walls, but each records them on its own clock
+    (``true + skew``).  ``steps_by_round[r]`` is this host's sweep-step
+    wall for round r; fetch pads every host to the common barrier."""
+    jitter = jitter or [0.0] * 8
+    fid = f"fit_h{h}"
+    ev = [
+        {"event": "fit_start", "fit_id": fid, "family": "GBM",
+         "ts": 99.0 + skew},
+        {"event": "dist_config", "fit_id": fid, "process": h, "hosts": 2,
+         "positions": 2, "ts": 99.5 + skew},
+        # barrier 1: the manifest-agreement all_gather returns at true
+        # wall 100.0 on every host
+        {"event": "dist_manifest_agreed", "fit_id": fid,
+         "ts": 100.0 + skew + jitter[0], "digest": digest},
+    ]
+    slowest = max(steps_by_round)
+    for r, steps in enumerate(steps_by_round):
+        # barrier: the blocking reduce fetch returns at the same true
+        # instant on every host — the slowest host's steps bound it
+        barrier = 101.0 + r + slowest
+        fetch = barrier - (101.0 + r + steps)
+        ev.append({
+            "event": "span", "name": "dist_level_0",
+            "trace_id": f"t{h}", "span_id": f"L{r}", "parent_id": "",
+            "ts": 101.0 + r + skew + jitter[1 + r],
+            "dur_s": steps + fetch + 0.01,
+            "pid": 1000 + h, "thread": f"host{h}", "fit_id": fid,
+            "steps_s": steps, "fetch_s": fetch, "round": r,
+        })
+    for site, seconds in stalls:
+        ev.append({"event": "host_stalled", "fit_id": fid,
+                   "ts": 101.2 + skew, "victim": h, "site": site,
+                   "seconds": seconds})
+    if flow_out is not None:
+        ev.append({
+            "event": "span", "name": "host_preempt",
+            "trace_id": f"t{h}", "span_id": "pre", "parent_id": "",
+            "ts": 103.0 + skew, "dur_s": 0.0, "pid": 1000 + h,
+            "thread": f"host{h}", "fit_id": fid, "flow_out": [flow_out],
+        })
+    if flow_in is not None:
+        ev.append({
+            "event": "span", "name": "rewind",
+            "trace_id": f"t{h}", "span_id": "rew", "parent_id": "",
+            "ts": 103.5 + skew, "dur_s": 0.0, "pid": 1000 + h,
+            "thread": f"host{h}", "fit_id": fid, "flow_in": flow_in,
+        })
+    return ev
+
+
+def test_offsets_recover_known_skew():
+    streams = [
+        _host_stream(0, 0.0, [0.05, 0.05]),
+        _host_stream(1, 3.7, [0.05, 0.05]),
+    ]
+    offsets = podview.estimate_offsets(streams)
+    assert offsets[0] == 0.0
+    assert abs(offsets[1] - 3.7) < 1e-9
+
+
+def test_offsets_tolerate_barrier_jitter():
+    """Hosts do not unblock at EXACTLY the same instant; the median over
+    matched barriers must still land within tolerance."""
+    streams = [
+        _host_stream(0, 0.0, [0.05, 0.05, 0.05],
+                     jitter=[0.002, -0.004, 0.001, 0.003]),
+        _host_stream(1, -1.25, [0.05, 0.05, 0.05],
+                     jitter=[-0.003, 0.004, -0.002, 0.001]),
+    ]
+    offsets = podview.estimate_offsets(streams)
+    assert abs(offsets[1] - (-1.25)) < 0.01
+
+
+def test_offsets_without_shared_barriers_default_to_zero():
+    streams = [_host_stream(0, 0.0, [0.05]), [{"event": "fit_start"}]]
+    assert podview.estimate_offsets(streams) == [0.0, 0.0]
+
+
+def test_stitch_aligns_rewrites_and_roots():
+    viewer = _load_tool("trace_viewer")
+    streams = [
+        _host_stream(0, 0.0, [0.05, 0.05]),
+        _host_stream(1, 3.7, [0.05, 0.05]),
+    ]
+    merged, info = podview.stitch(streams)
+    assert info["hosts"] == [0, 1]
+    assert abs(info["offsets"][1] - 3.7) < 1e-9
+    assert info["groups"] == 1
+    assert info["digest_mismatches"] == []
+    spans = viewer.select_spans(merged)
+    assert viewer.validate(spans) == []
+    # ids prefixed per host, dist spans regrouped under the pod trace
+    by_id = {s["span_id"]: s for s in spans}
+    assert "h0.L0" in by_id and "h1.L0" in by_id
+    assert by_id["h0.L0"]["trace_id"] == "pod.0"
+    assert by_id["h0.L0"]["parent_id"] == "pod.0.root"
+    root = by_id["pod.0.root"]
+    assert root["name"] == "pod_fit_0" and root["thread"] == "pod"
+    # aligned timelines: the same round starts at the same pod ts
+    assert abs(by_id["h0.L0"]["ts"] - by_id["h1.L0"]["ts"]) < 1e-6
+    # the merged stream is sorted by aligned ts
+    ts = [float(e.get("ts", 0.0)) for e in merged]
+    assert ts == sorted(ts)
+
+
+def test_digest_mismatch_reported_not_fatal():
+    streams = [
+        _host_stream(0, 0.0, [0.05], digest="aaaa"),
+        _host_stream(1, 0.0, [0.05], digest="bbbb"),
+    ]
+    merged, info = podview.stitch(streams)
+    assert info["digest_mismatches"] == [
+        {"group": 0, "digests": {0: "aaaa", 1: "bbbb"}}
+    ]
+    assert merged  # the trace is still produced
+
+
+def test_cross_host_flow_resolves_only_when_stitched():
+    viewer = _load_tool("trace_viewer")
+    fid = 424242
+    victim = _host_stream(1, 0.0, [0.05], flow_out=fid)
+    survivor = _host_stream(0, 0.0, [0.05], flow_in=fid)
+    # the survivor alone: rewind's flow_in has no source
+    assert viewer.validate(viewer.select_spans(survivor))
+    merged, _ = podview.stitch([survivor, victim])
+    assert viewer.validate(viewer.select_spans(merged)) == []
+
+
+def test_skew_report_names_the_straggler():
+    streams = [
+        _host_stream(0, 0.0, [0.05, 0.05]),
+        _host_stream(1, 2.0, [0.05, 0.45],
+                     stalls=[("GBM:stream_round:1:level:0:dist_step:0",
+                              0.4)]),
+    ]
+    report = podview.skew_report(streams)
+    assert report["hosts"] == [0, 1]
+    r1 = next(r for r in report["rounds"] if r["round"] == 1)
+    assert r1["offender"] == 1
+    assert r1["ratio"] > 1.5
+    assert report["persistent_offender"] == 1
+    assert report["pod_skew_ratio"] > 1.0
+    assert report["stalls"]["1"]["count"] == 1
+    text = podview.render_skew(report)
+    assert "== pod skew ==" in text
+    assert "offender host 1" in text
+    assert "stalls: host 1" in text
+
+
+def test_skew_report_single_host_is_healthy():
+    report = podview.skew_report([_host_stream(0, 0.0, [0.05])])
+    assert report["pod_skew_ratio"] == 1.0
+
+
+def test_expand_inputs_walks_dirs_deterministically(tmp_path):
+    (tmp_path / "sub").mkdir()
+    for name in ("b.jsonl", "a.jsonl", "sub/c.jsonl", "skip.txt"):
+        (tmp_path / name).write_text("{}\n")
+    got = podview.expand_inputs([str(tmp_path),
+                                 str(tmp_path / "a.jsonl")])  # dup dropped
+    assert [os.path.basename(p) for p in got] == [
+        "a.jsonl", "b.jsonl", "c.jsonl"
+    ]
+
+
+def _write_streams(tmp_path, streams):
+    paths = []
+    for i, ev in enumerate(streams):
+        p = tmp_path / f"telemetry_p{i}.jsonl"
+        p.write_text("".join(json.dumps(e) + "\n" for e in ev))
+        paths.append(str(p))
+    return paths
+
+
+def test_trace_viewer_cli_multi_input(tmp_path, capsys):
+    viewer = _load_tool("trace_viewer")
+    fid = 77
+    paths = _write_streams(tmp_path, [
+        _host_stream(0, 0.0, [0.05], flow_in=fid),
+        _host_stream(1, 1.5, [0.05], flow_out=fid),
+    ])
+    # validate-only over the pair
+    assert viewer.main(["--jsonl", *paths, "--validate"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["problems"] == 0 and summary["hosts"] == [0, 1]
+    # survivor alone fails by design
+    assert viewer.main(["--jsonl", paths[0], "--validate"]) == 1
+    capsys.readouterr()
+    # directory export: host track groups named in the Perfetto JSON
+    out = tmp_path / "pod.json"
+    assert viewer.main(["--jsonl", str(tmp_path), "--out", str(out)]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["hosts"] == [0, 1]
+    trace = json.loads(out.read_text())
+    names = {
+        e["args"]["name"] for e in trace["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert {"host0", "host1"} <= names
+
+
+def test_telemetry_report_cli_multi_input(tmp_path, capsys):
+    report = _load_tool("telemetry_report")
+    paths = _write_streams(tmp_path, [
+        _host_stream(0, 0.0, [0.05, 0.05]),
+        _host_stream(1, 0.0, [0.05, 0.30]),
+    ])
+    assert report.main(paths) == 0
+    text = capsys.readouterr().out
+    assert "== pod skew ==" in text
+    assert "offender host 1" in text

@@ -63,3 +63,16 @@ def test_fit_filter_and_aggregate_jsonl(tmp_path, capsys):
 
 def test_missing_fit_filter_fails(capsys):
     assert report.main([CANNED, "--fit", "nope"]) == 1
+
+
+def test_directory_input_matches_single_file_golden(tmp_path, capsys):
+    """Satellite contract: pointing the tool at a DIRECTORY holding the
+    same single stream renders the same per-fit sections; with only one
+    host there is no pod skew signal, so no skew section appears and the
+    output stays byte-identical to the golden."""
+    import shutil
+
+    shutil.copy(CANNED, tmp_path / "telemetry_p0.jsonl")
+    assert report.main([str(tmp_path)]) == 0
+    got = capsys.readouterr().out
+    assert got == open(GOLDEN).read()

@@ -60,6 +60,12 @@ METRICS: Dict[str, Any] = {
     # serializing fences, so it is noisy — wide floors)
     "multihost_rows_per_sec": ("higher", 0.25, 0.0),
     "dcn_reduce_share":       ("lower", 0.25, 0.05),
+    # pod observability (telemetry/podview.py): max/median per-host work
+    # skew in the multihost leg (simulated hosts on one process — small
+    # true skew, wide floors), and the measured-vs-estimated ledger's
+    # roofline error (a model-quality tripwire, not a perf number)
+    "pod_skew_ratio":        ("lower", 0.50, 0.25),
+    "cost_model_error_pct":  ("lower", 0.50, 10.0),
 }
 
 

@@ -16,6 +16,11 @@ split.  ``--jsonl PATH`` re-emits the aggregated table as
 against such a file.
 
 Pure stdlib + the profiling formatter: usable on a host with no jax.
+
+Pod mode: pass several per-host JSONL files (or a directory of them) and
+the report appends a ``== pod skew ==`` section — per-host sweep/fetch/
+reduce/shard-wait totals, per-round max/median skew ratios with the
+offending host, and injected-stall attribution (telemetry/podview.py).
 """
 
 from __future__ import annotations
@@ -29,6 +34,7 @@ from typing import Dict, List, Optional, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from spark_ensemble_tpu.telemetry import podview  # noqa: E402
 from spark_ensemble_tpu.utils.profiling import (  # noqa: E402
     format_summary,
     rows_to_records,
@@ -132,6 +138,38 @@ def round_cost_line(fit_events: List[dict]) -> Optional[str]:
     mfu = ev.get("mfu_est")
     if mfu is not None:
         parts.append(f"mfu_est {100.0 * float(mfu):.2f}%")
+    return "  ".join(parts)
+
+
+def cost_model_line(fit_events: List[dict]) -> Optional[str]:
+    """Measured-vs-estimated ledger: median modeled round time (roofline
+    from ``round_cost_est``) against the median measured round, the
+    resulting error, and the recompiles the ledger attributed to round
+    chunks.  Only fits whose round_end events carry ``modeled_s`` (i.e.
+    emitted after the ledger landed) get the line."""
+    ends = [
+        e
+        for e in fit_events
+        if e.get("event") == "round_end" and "modeled_s" in e
+    ]
+    if not ends:
+        return None
+    modeled = sorted(float(e["modeled_s"]) for e in ends)
+    measured = sorted(float(e.get("duration_s", 0.0)) for e in ends)
+    parts = [
+        f"cost model: modeled {modeled[len(modeled) // 2] * 1e3:.2f}ms/round"
+        f"  measured {measured[len(measured) // 2] * 1e3:.2f}ms/round"
+    ]
+    errs = sorted(
+        float(e["cost_model_error_pct"])
+        for e in ends
+        if "cost_model_error_pct" in e
+    )
+    if errs:
+        parts.append(f"error {errs[len(errs) // 2]:.1f}%")
+    compiles = sum(int(e.get("chunk_compiles", 0)) for e in ends)
+    if compiles:
+        parts.append(f"chunk compiles {compiles}")
     return "  ".join(parts)
 
 
@@ -251,6 +289,9 @@ def render_fit(fit_id: str, fit_events: List[dict]) -> str:
     cost = round_cost_line(fit_events)
     if cost:
         lines.append(cost)
+    model = cost_model_line(fit_events)
+    if model:
+        lines.append(model)
     shard_io = shard_io_line(fit_events)
     if shard_io:
         lines.append(shard_io)
@@ -302,7 +343,12 @@ def render_diff(records_a: List[dict], records_b: List[dict]) -> str:
 
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("jsonl_path", help="telemetry JSONL stream to render")
+    ap.add_argument(
+        "jsonl_path",
+        nargs="+",
+        help="telemetry JSONL stream(s) to render; several files or a "
+        "directory of per-host streams add the pod skew section",
+    )
     ap.add_argument(
         "--fit",
         help="only render fits whose fit_id contains this substring",
@@ -320,9 +366,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         "tool or utils/profiling.py --jsonl)",
     )
     args = ap.parse_args(argv)
-    events = load_events(args.jsonl_path)
+    streams: Optional[List[List[dict]]] = None
+    if len(args.jsonl_path) == 1 and not os.path.isdir(args.jsonl_path[0]):
+        events = load_events(args.jsonl_path[0])
+    else:
+        inputs = podview.expand_inputs(args.jsonl_path)
+        streams = [load_events(p) for p in inputs]
+        events = [ev for stream in streams for ev in stream]
     if not events:
-        print(f"no telemetry events found in {args.jsonl_path}")
+        print(f"no telemetry events found in {', '.join(args.jsonl_path)}")
         return 1
     fits = group_fits(events)
     if args.fit:
@@ -333,6 +385,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     for fit_id in sorted(fits):
         print(render_fit(fit_id, fits[fit_id]))
         print()
+    if streams is not None:
+        skew = podview.skew_report(streams)
+        # a lone host has no pod to skew against — only render when the
+        # inputs span hosts (or a chaos stall demands attribution), so a
+        # directory holding one stream matches the single-file output
+        if len(skew["hosts"]) > 1 or skew["stalls"]:
+            print(podview.render_skew(skew))
+            print()
     rows, total = aggregate_rows(fits)
     if args.jsonl:
         write_jsonl(rows_to_records(rows, total), args.jsonl)

@@ -19,18 +19,42 @@ Usage:
 
     python tools/trace_viewer.py --jsonl telemetry.jsonl --out trace.json
     python tools/trace_viewer.py --jsonl telemetry.jsonl --validate
+    # pod mode: several per-host streams (or a directory of them) are
+    # stitched into one pod-level trace via telemetry/podview.py —
+    # host{i} track groups, clock-offset alignment, cross-host flows
+    python tools/trace_viewer.py --jsonl host0.jsonl host1.jsonl --out pod.json
+    python tools/trace_viewer.py --jsonl artifacts/ --validate
 
 ``--validate`` (also run implicitly before export) checks the span graph:
 every non-empty ``parent_id`` must resolve to an emitted span and every
 ``flow_in`` must have a matching ``flow_out`` source.  Exit code 1 on any
 unresolved edge — the CI serving-chaos and streaming jobs gate on it.
-stdlib-only: runs anywhere the JSONL landed, no jax required.
+A survivor's stream from a preempted pod fails alone (its rewind flow has
+no source) and passes stitched — by design: the pod view IS the complete
+trace.  stdlib-only: runs anywhere the JSONL landed, no jax required;
+podview is loaded by file path so that contract survives pod mode.
 """
 
 import argparse
+import importlib.util
 import json
+import os
 import sys
 from typing import Any, Dict, List, Optional, Tuple
+
+
+def _load_podview():
+    """telemetry/podview.py by file path — a normal package import would
+    drag in jax via the package __init__, breaking this tool's
+    runs-anywhere contract (podview itself is pure stdlib)."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "spark_ensemble_tpu", "telemetry", "podview.py",
+    )
+    spec = importlib.util.spec_from_file_location("_se_tpu_podview", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 #: standalone event types rendered as instant markers on their track
 INSTANT_EVENTS = ("hedge_fired", "replica_state", "request_shed")
@@ -84,10 +108,11 @@ def validate(spans: List[Dict[str, Any]]) -> List[str]:
     return problems
 
 
-#: span-record keys that are structure, not user attributes
+#: span-record keys that are structure, not user attributes ("host" is
+#: stamped by podview stitching; single-stream spans never carry it)
 _STRUCT_KEYS = (
     "event", "name", "trace_id", "span_id", "parent_id", "ts", "dur_s",
-    "pid", "thread", "flow_in", "flow_out", "fit_id", "wall_time",
+    "pid", "thread", "flow_in", "flow_out", "fit_id", "wall_time", "host",
 )
 
 
@@ -114,8 +139,17 @@ def to_trace_events(
     tids: Dict[Tuple[int, str], int] = {}
     meta: List[Dict[str, Any]] = []
     out: List[Dict[str, Any]] = []
+    named_pids: set = set()
     for s in spans:
         pid = int(s.get("pid", 0))
+        # stitched pod traces: name each process row after its host so
+        # the viewer shows host{i} track groups (first-seen wins)
+        if "host" in s and pid not in named_pids:
+            named_pids.add(pid)
+            meta.append({
+                "ph": "M", "name": "process_name", "pid": pid,
+                "args": {"name": f"host{s['host']}"},
+            })
         tid = _tid_for(pid, s.get("thread"), tids, meta)
         ts_us = float(s.get("ts", 0.0)) * 1e6
         dur_us = max(float(s.get("dur_s", 0.0)) * 1e6, 1.0)
@@ -161,14 +195,15 @@ def to_trace_events(
     return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
 
 
-def export(
-    jsonl_path: str,
+def export_events(
+    events: List[Dict[str, Any]],
     out_path: Optional[str] = None,
     trace_id: Optional[str] = None,
+    hosts: Optional[List[int]] = None,
 ) -> Dict[str, Any]:
-    """Load + validate + convert; returns a summary dict (the CLI prints
-    it).  Raises ``ValueError`` on an unresolved span graph."""
-    events = load_events(jsonl_path)
+    """Validate + convert an in-memory event list (one stream's, or the
+    pod-stitched merge); returns a summary dict (the CLI prints it).
+    Raises ``ValueError`` on an unresolved span graph."""
     spans = select_spans(events, trace_id=trace_id)
     problems = validate(spans)
     if problems:
@@ -186,7 +221,7 @@ def export(
         (s.get("pid"), s.get("thread") or "main") for s in spans
     }
     flows = sum(len(s.get("flow_out") or []) for s in spans)
-    return {
+    summary = {
         "spans": len(spans),
         "tracks": len(tracks),
         "flows": flows,
@@ -194,12 +229,39 @@ def export(
         "traces": sorted({s.get("trace_id", "") for s in spans}),
         "out": out_path,
     }
+    if hosts is not None:
+        summary["hosts"] = hosts
+    return summary
+
+
+def export(
+    jsonl_path: str,
+    out_path: Optional[str] = None,
+    trace_id: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Single-stream entry point: load one JSONL file, then
+    :func:`export_events`."""
+    return export_events(load_events(jsonl_path), out_path, trace_id=trace_id)
+
+
+def _resolve_events(
+    inputs: List[str],
+) -> Tuple[List[Dict[str, Any]], Optional[List[int]]]:
+    """One file → that stream untouched; several files or any directory →
+    the pod-stitched merge.  Returns (events, hosts-or-None)."""
+    if len(inputs) == 1 and not os.path.isdir(inputs[0]):
+        return load_events(inputs[0]), None
+    pv = _load_podview()
+    merged, info = pv.stitch_files(inputs)
+    return merged, info["hosts"]
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--jsonl", required=True,
-                        help="telemetry JSONL stream to read")
+    parser.add_argument("--jsonl", required=True, nargs="+",
+                        help="telemetry JSONL stream(s) to read; several "
+                             "files or a directory are stitched into one "
+                             "pod-level trace")
     parser.add_argument("--out", default=None,
                         help="write Perfetto trace_event JSON here")
     parser.add_argument("--trace", default=None,
@@ -207,15 +269,20 @@ def main(argv=None) -> int:
     parser.add_argument("--validate", action="store_true",
                         help="only check the span graph; no export")
     args = parser.parse_args(argv)
+    events, hosts = _resolve_events(args.jsonl)
     if args.validate and not args.out:
-        spans = select_spans(load_events(args.jsonl), trace_id=args.trace)
+        spans = select_spans(events, trace_id=args.trace)
         problems = validate(spans)
         for p in problems:
             print(f"UNRESOLVED: {p}", file=sys.stderr)
-        print(json.dumps({"spans": len(spans), "problems": len(problems)}))
+        summary = {"spans": len(spans), "problems": len(problems)}
+        if hosts is not None:
+            summary["hosts"] = hosts
+        print(json.dumps(summary))
         return 1 if problems else 0
     try:
-        summary = export(args.jsonl, args.out, trace_id=args.trace)
+        summary = export_events(events, args.out, trace_id=args.trace,
+                                hosts=hosts)
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 1
