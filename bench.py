@@ -854,6 +854,79 @@ def inner():
     _, base2_fit_s = _timed_fit(est.copy(), X, y)
     trace_overhead_pct = 100.0 * (base2_fit_s - base_fit_s) / base_fit_s
 
+    # live operator plane (docs/operator.md): the same warm fit with the
+    # program inventory capturing, the HBM sampler + watchdog running,
+    # and a scraper thread sweeping /metrics + /programz + /healthz four
+    # times a second (~60x hotter than a production Prometheus interval)
+    # for the whole fit.  Baselined against an ADJACENT warm fit that is
+    # ALSO under record_fits, so the recorder's own cost cancels and the
+    # delta is purely plane + scrape.  The sentinel pins it as
+    # exporter_overhead_pct: scraping a production process must be free.
+    # The scraped fit's round ledger also yields
+    # xla_vs_analytic_cost_ratio — XLA's own flop count for the chunk
+    # program against ops/tree.py round_cost_est — the cost-model
+    # cross-check the sentinel floors against drift.
+    import threading as _threading
+    import urllib.request as _urlreq
+
+    from spark_ensemble_tpu.telemetry import start_operator_plane
+
+    operator_stats = {}
+    xla_vs_analytic_cost_ratio = None
+    try:
+        with record_fits():
+            _, opbase_fit_s = _timed_fit(est.copy(), X, y)
+        plane = start_operator_plane(
+            port=0, sampler_interval_s=0.25, watchdog_interval_s=0.5
+        )
+        plane.sampler._per_tick = 8  # drain analysis fast on short fits
+        scrape_stop = _threading.Event()
+        scrapes = [0]
+
+        def _scraper():
+            while not scrape_stop.is_set():
+                for ep in ("/metrics", "/programz?n=5", "/healthz"):
+                    try:
+                        with _urlreq.urlopen(plane.url + ep, timeout=5) as r:
+                            r.read()
+                    except OSError:
+                        pass
+                scrapes[0] += 1
+                scrape_stop.wait(0.25)
+
+        scraper = _threading.Thread(target=_scraper, daemon=True)
+        scraper.start()
+        try:
+            with record_fits() as oprec:
+                _, scraped_fit_s = _timed_fit(est.copy(), X, y)
+        finally:
+            scrape_stop.set()
+            scraper.join(timeout=5)
+        ratios = sorted(
+            float(e["xla_vs_analytic_flops_ratio"])
+            for e in oprec.events
+            if e.get("event") == "round_end"
+            and "xla_vs_analytic_flops_ratio" in e
+        )
+        if ratios:
+            xla_vs_analytic_cost_ratio = ratios[len(ratios) // 2]
+        inv_summary = plane.inventory.summary()
+        operator_stats = {
+            "scraped_fit_seconds": round(scraped_fit_s, 3),
+            "quiet_fit_seconds": round(opbase_fit_s, 3),
+            "scrape_loops": scrapes[0],
+            "programs": inv_summary["programs"],
+            "analyzed": inv_summary["analyzed"],
+            "rounds_with_xla_fields": len(ratios),
+        }
+        plane.stop()
+        exporter_overhead_pct = (
+            100.0 * (scraped_fit_s - opbase_fit_s) / opbase_fit_s
+        )
+    except Exception as e:  # noqa: BLE001 - carry, keep going
+        operator_stats = {"error": str(e)[:200]}
+        exporter_overhead_pct = None
+
     # numeric-guard overhead: the default fit above runs with the guard on
     # (on_nonfinite="raise"); an adjacent warm fit with the guard off
     # isolates the per-chunk non-finite reduction + host sync cost
@@ -1048,6 +1121,17 @@ def inner():
         "hist_precision": hist_precision,
         "telemetry_overhead_pct": round(telemetry_overhead_pct, 2),
         "trace_overhead_pct": round(trace_overhead_pct, 2),
+        "exporter_overhead_pct": (
+            round(exporter_overhead_pct, 2)
+            if exporter_overhead_pct is not None
+            else None
+        ),
+        "xla_vs_analytic_cost_ratio": (
+            round(xla_vs_analytic_cost_ratio, 4)
+            if xla_vs_analytic_cost_ratio is not None
+            else None
+        ),
+        "operator": operator_stats,
         "cost_model_error_pct": (
             round(cost_model_error_pct, 2)
             if cost_model_error_pct is not None

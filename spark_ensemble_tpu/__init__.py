@@ -94,16 +94,26 @@ from spark_ensemble_tpu import telemetry
 from spark_ensemble_tpu.telemetry import (
     FitTelemetry,
     FlightRecorder,
+    HbmSampler,
     MetricsRegistry,
+    OperatorPlane,
+    OperatorServer,
+    ProgramInventory,
+    ProgramRecord,
     Span,
     TelemetryRecorder,
     TraceContext,
     Tracer,
+    Watchdog,
     dump_flight,
+    global_inventory,
     record_fits,
+    render_openmetrics,
     skew_report,
+    start_operator_plane,
     stitch_files,
     trace_annotations_enabled,
+    validate_openmetrics,
 )
 from spark_ensemble_tpu import robustness
 from spark_ensemble_tpu.robustness import (
@@ -233,6 +243,16 @@ __all__ = [
     "TraceContext",
     "Tracer",
     "trace_annotations_enabled",
+    "ProgramInventory",
+    "ProgramRecord",
+    "HbmSampler",
+    "global_inventory",
+    "OperatorPlane",
+    "OperatorServer",
+    "Watchdog",
+    "render_openmetrics",
+    "start_operator_plane",
+    "validate_openmetrics",
     "ChaosController",
     "ChaosPreemption",
     "ChaosTransientError",

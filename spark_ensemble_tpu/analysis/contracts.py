@@ -737,6 +737,87 @@ def _trace_tracing(report: ContractReport) -> None:
         )
 
 
+def _trace_operator(report: ContractReport) -> None:
+    """Trace the live operator plane's own budget (docs/operator.md).
+
+    Two pins.  First, a full scrape — OpenMetrics render, ``/programz``
+    rows, a watchdog tick, the ``/healthz`` verdict — over an inventory
+    populated by a real fit must dispatch ZERO cached device programs
+    (``operator.scrape``): scraping a production process can never be
+    the thing that compiles or recomputes.  Second, the watchdog and
+    exporter sources must contain no unfenced blocking reads
+    (``operator.lint``): linted here with absolute paths, which bypasses
+    the blanket ``telemetry/`` fence-module exemption the repo-wide lint
+    applies, so the operator threads are held to the *device-producer*
+    standard even though they live in the telemetry package."""
+    from spark_ensemble_tpu.analysis.lint import lint_file
+    from spark_ensemble_tpu.models.base import observe_program_calls
+    from spark_ensemble_tpu.telemetry import exporter, programz, watchdog
+
+    import spark_ensemble_tpu as se
+
+    X, y = _canonical_data(False)
+    inventory = programz.enable()
+    inventory.clear()
+    try:
+        se.GBMRegressor(
+            base_learner=se.DecisionTreeRegressor(max_depth=3),
+            num_base_learners=3,
+            seed=0,
+        ).fit(X, y)
+        inventory.analyze_pending()  # shallow: zero backend compiles
+        dog = watchdog.Watchdog(interval_s=3600.0)
+        rec = _ProgramRecorder()
+        with observe_program_calls(rec):
+            text = exporter.render_openmetrics()
+            rows = inventory.rows(top=10)
+            dog.evaluate_once()
+            verdict = dog.verdict()
+        report.budgets["operator.scrape"] = rec.count()
+        problems = exporter.validate_openmetrics(text)
+        if problems:
+            report.violations.append(
+                ContractViolation(
+                    "operator",
+                    "operator.scrape",
+                    "the /metrics exposition fails the OpenMetrics "
+                    f"checker: {problems[:3]}",
+                )
+            )
+        if not rows or verdict.get("status") not in ("ok", "degraded"):
+            report.violations.append(
+                ContractViolation(
+                    "operator",
+                    "operator.scrape",
+                    f"scrape returned no inventory rows ({len(rows)}) or "
+                    f"a malformed verdict ({verdict.get('status')!r})",
+                )
+            )
+    finally:
+        programz.disable()
+        inventory.clear()
+    findings = []
+    for mod in (watchdog, exporter):
+        findings.extend(
+            f
+            for f in lint_file(
+                os.path.abspath(mod.__file__),
+                select=["unfenced-blocking-read"],
+            )
+            if not f.suppressed
+        )
+    report.budgets["operator.lint"] = len(findings)
+    for f in findings:
+        report.violations.append(
+            ContractViolation(
+                "operator",
+                "operator.lint",
+                f"unfenced blocking read in an operator thread: "
+                f"{f.path}:{f.line}: {f.message}",
+            )
+        )
+
+
 def trace_contracts(
     entry_points: Optional[List[str]] = None,
 ) -> ContractReport:
@@ -763,6 +844,8 @@ def trace_contracts(
             _trace_streaming_dist(report)
         if wanted is None or "tracing" in wanted:
             _trace_tracing(report)
+        if wanted is None or "operator" in wanted:
+            _trace_operator(report)
     return report
 
 

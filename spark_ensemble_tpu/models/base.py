@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Callable, Optional
 
@@ -94,6 +95,26 @@ _PROGRAM_CACHE_LOCK = threading.Lock()
 # never stored in the cache — a later unobserved caller gets the raw fn.
 _PROGRAM_OBSERVERS: list = []
 
+# Operator-plane program sink (telemetry/programz.py): unlike the scoped
+# observers above, the sink is a process-lifetime hook the live program
+# inventory installs once.  It receives every call with its measured call
+# wall and (on cache misses) the program build wall, so /programz can
+# attribute compile cost per program.  Held in a one-slot list so the
+# call-time check is one global load; when no sink is installed AND no
+# observer is registered, `_maybe_observed` hands back the raw fn and the
+# hot path pays nothing.
+_PROGRAM_SINK: list = [None]
+
+
+def set_program_sink(sink) -> None:
+    """Install (or, with ``None``, remove) the process-wide program-call
+    sink: ``sink(tag, signature, fn, args, kwargs, call_s, build_s)``.
+    One slot only — the operator plane owns it (telemetry/programz.py);
+    programs fetched while neither a sink nor an observer was active are
+    unwrapped and stay invisible, so enable the inventory before fitting.
+    """
+    _PROGRAM_SINK[0] = sink
+
 
 def observe_program_calls(callback):
     """Context manager registering ``callback(tag, signature, fn, args,
@@ -130,8 +151,10 @@ def _aval_signature(args, kwargs=None) -> tuple:
     return tuple(sig)
 
 
-def _maybe_observed(key: tuple, fn: Callable) -> Callable:
-    if not _PROGRAM_OBSERVERS:
+def _maybe_observed(
+    key: tuple, fn: Callable, build_s: Optional[float] = None
+) -> Callable:
+    if not _PROGRAM_OBSERVERS and _PROGRAM_SINK[0] is None:
         return fn
     tag = key[0] if key and isinstance(key[0], str) else repr(key[:1])
 
@@ -139,7 +162,16 @@ def _maybe_observed(key: tuple, fn: Callable) -> Callable:
         sig = _aval_signature(args, kwargs)
         for cb in list(_PROGRAM_OBSERVERS):
             cb(tag, sig, fn, args, kwargs)
-        return fn(*args, **kwargs)
+        sink = _PROGRAM_SINK[0]
+        if sink is None:
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        # call wall is dispatch wall (async backends return before the
+        # program finishes); the inventory uses the FIRST call's wall as
+        # the trace+compile attribution, which is the synchronous part
+        sink(tag, sig, fn, args, kwargs, time.perf_counter() - t0, build_s)
+        return out
 
     return observed
 
@@ -172,7 +204,9 @@ def cached_program(key: tuple, build: Callable[[], Callable]) -> Callable:
         if fn is not None:
             _PROGRAM_CACHE.move_to_end(key)
             return _maybe_observed(key, fn)
+    t_build = time.perf_counter()
     fn = build()
+    build_s = time.perf_counter() - t_build
     with _PROGRAM_CACHE_LOCK:
         existing = _PROGRAM_CACHE.get(key)
         if existing is not None:
@@ -182,7 +216,7 @@ def cached_program(key: tuple, build: Callable[[], Callable]) -> Callable:
         _PROGRAM_CACHE[key] = fn
         while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_SIZE:
             _PROGRAM_CACHE.popitem(last=False)
-    return _maybe_observed(key, fn)
+    return _maybe_observed(key, fn, build_s=build_s)
 
 
 # ---------------------------------------------------------------------------

@@ -1096,6 +1096,14 @@ def round_cost_est(
     identical across tiers, so ``mfu_est = flops_est / (round_seconds *
     peak_flops)`` is comparable between tiers.  Feeds FitTelemetry round
     events (models/gbm.py) and the bench hist-tier A/B leg.
+
+    The live operator plane cross-checks this model against XLA's own
+    ``cost_analysis()`` for the round program
+    (``xla_vs_analytic_flops_ratio`` in round_end events and bench
+    output, sentinel-floored).  The two deliberately diverge: this
+    model charges every level its full node dims (no
+    sibling-subtraction credit), so the ratio sits well below 1 on CPU
+    — see docs/operator.md#the-cost-triangle for the documented band.
     """
     B = max_bins
     C = 1 + k

@@ -872,7 +872,9 @@ class ElasticCoordinator:
         the last distributed fit's sweep/reduce walls.  Registered as a
         ``global_metrics()`` source for the duration of each
         ``fit_streaming`` call, so a mid-fit snapshot (/statusz pages,
-        the flight-recorder dump) shows where the pod stands."""
+        the flight-recorder dump) shows where the pod stands — and the
+        operator plane's /metrics exporter flattens the numeric leaves
+        into the ``se_tpu_elastic`` gauge family (docs/operator.md)."""
         width = 1
         for a in mesh_row_axes(self.mesh):
             width *= int(self.mesh.shape[a])

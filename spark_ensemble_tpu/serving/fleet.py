@@ -840,6 +840,12 @@ class FleetRouter:
                 "degraded_share": (
                     self._counters["degraded"] / requests if requests else 0.0
                 ),
+                # probed live by the watchdog via the fleet/* source
+                # (docs/operator.md) — keep in the SLO row, not just statusz
+                "hedge_rate": (
+                    self._counters["hedges_fired"] / requests
+                    if requests else 0.0
+                ),
                 "compiles_since_warmup": c - self._warm_snapshot[0],
                 "compile_s_since_warmup": s - self._warm_snapshot[1],
                 "prefix_tiers": self._tiers,

@@ -7,6 +7,10 @@ per-phase cost table ``utils/profiling.py`` produces from profiler traces.
 Pod scope (``docs/tracing.md#pod-scope``): ``podview`` stitches per-host
 streams into one pod trace and folds straggler skew; ``flight`` keeps the
 per-process crash ring dumped on preemption.
+Live operator plane (``docs/operator.md``): ``programz`` keeps the
+per-compiled-program XLA cost inventory, ``exporter`` serves it (with the
+whole registry) over ``/metrics``/``/statusz``/``/programz``/``/healthz``,
+and ``watchdog`` applies the perf-sentinel thresholds online.
 """
 
 from spark_ensemble_tpu.telemetry.flight import (
@@ -37,6 +41,27 @@ from spark_ensemble_tpu.telemetry.events import (
     record_fits,
     serving_stream_id,
     telemetry_sink_active,
+)
+from spark_ensemble_tpu.telemetry.exporter import (
+    OperatorPlane,
+    OperatorServer,
+    render_openmetrics,
+    start_operator_plane,
+    validate_openmetrics,
+    write_snapshot,
+)
+from spark_ensemble_tpu.telemetry.programz import (
+    HbmSampler,
+    ProgramInventory,
+    ProgramRecord,
+    global_inventory,
+    xla_cost_fields,
+)
+from spark_ensemble_tpu.telemetry.watchdog import (
+    Rule,
+    Watchdog,
+    default_rules,
+    sentinel_thresholds,
 )
 from spark_ensemble_tpu.telemetry.trace import (
     NULL_SPAN,
@@ -80,4 +105,19 @@ __all__ = [
     "skew_report",
     "stitch",
     "stitch_files",
+    "ProgramInventory",
+    "ProgramRecord",
+    "HbmSampler",
+    "global_inventory",
+    "xla_cost_fields",
+    "OperatorPlane",
+    "OperatorServer",
+    "render_openmetrics",
+    "start_operator_plane",
+    "validate_openmetrics",
+    "write_snapshot",
+    "Rule",
+    "Watchdog",
+    "default_rules",
+    "sentinel_thresholds",
 ]

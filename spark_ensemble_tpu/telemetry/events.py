@@ -89,6 +89,11 @@ SERVING_EVENT_TYPES = (
     # causal tracing plane (docs/tracing.md): span records emitted by the
     # serving fleet / engine ride the same standalone-event chokepoint
     "span",
+    # operator plane (docs/operator.md): the online watchdog's SLO breach
+    # raise/clear records (telemetry/watchdog.py) and /programz inventory
+    # rows snapshotted into the stream (ProgramInventory.emit_rows)
+    "slo_alert",
+    "program",
 )
 
 # ---------------------------------------------------------------------------
@@ -585,6 +590,24 @@ class FitTelemetry:
                 cost_fields["cost_model_error_pct"] = (
                     100.0 * abs(per_round - modeled) / per_round
                 )
+                # live copy for the online watchdog (docs/operator.md):
+                # the sentinel's cost-model tripwire, readable mid-fit
+                _GLOBAL.gauge("fit/cost_model_error_pct").set(
+                    cost_fields["cost_model_error_pct"]
+                )
+        # three-way cost line (docs/operator.md): when the program
+        # inventory is live, join the chunk program's XLA analysis —
+        # measured wall (duration_s) vs analytic roofline (modeled_s)
+        # vs XLA (xla_modeled_s), with MFU recomputed from XLA flops
+        from spark_ensemble_tpu.telemetry import programz as _programz
+
+        if _programz.enabled():
+            cost_fields.update(
+                _programz.xla_cost_fields(
+                    round_cost, per_round,
+                    divisor if divisor else count,
+                )
+            )
         for j in range(count):
             rnd = start_round + j
             li = rnd if learner_index is None else learner_index
@@ -688,6 +711,12 @@ class FitTelemetry:
             "compile_s": s1 - self._compile0[1],
             "host_blocked_us": self._host_blocked_s * 1e6,
         }
+        if wall > 0:
+            # live copy for the online watchdog (docs/operator.md): the
+            # host-blocked share of the most recent finished fit
+            _GLOBAL.gauge("fit/host_blocked_share").set(
+                self._host_blocked_s / wall
+            )
         mem = device_memory_stats()
         if mem:
             ev["memory"] = mem

@@ -1,0 +1,492 @@
+"""Per-program XLA cost inventory — the ``/programz`` operator surface.
+
+The round ledger (telemetry/events.py) prices every round with the
+hand-written analytic model ``ops/tree.py:round_cost_est``; this module
+adds the third leg of the cost triangle: what XLA itself says the
+compiled program costs.  A process-wide :class:`ProgramInventory` hooks
+the ``cached_program`` / ``_predict_program`` chokepoints in
+``models/base.py`` (via :func:`~spark_ensemble_tpu.models.base.
+set_program_sink`) and records, per distinct ``(tag, abstract argument
+signature)`` program: call count, build wall, first-call wall (the
+synchronous trace+compile part of dispatch), and — once analyzed — the
+XLA ``cost_analysis()`` / ``memory_analysis()`` numbers (flops, bytes
+accessed, argument/output/temp HBM).
+
+Analysis is deliberately decoupled from capture:
+
+- **capture** is a dict update per call (safe on fit and serve paths);
+- **analysis** re-lowers the program from stored ``ShapeDtypeStruct``
+  avals (no device buffers are retained) and asks XLA for its cost
+  model.  ``deep=False`` (the default used by the background sampler)
+  stops at ``Lowered.cost_analysis()`` — **zero backend compiles**, so
+  the zero-compile serving contracts cannot be perturbed; ``deep=True``
+  additionally compiles for ``memory_analysis()`` (explicit calls only).
+
+``/programz`` scrapes (telemetry/exporter.py) render *stored* rows and
+never trace, lower, or compile — the tier-2 ``operator.scrape`` contract
+pins that.  The :class:`HbmSampler` is the background HBM-watermark
+thread feeding ``hbm/<dev>/*`` gauges in ``global_metrics()`` and
+draining pending (shallow) analysis off the hot path.
+
+See docs/operator.md for the row schema and the documented CPU tolerance
+between XLA flops and the analytic ``round_cost_est``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "ProgramRecord",
+    "ProgramInventory",
+    "HbmSampler",
+    "global_inventory",
+    "enable",
+    "disable",
+    "enabled",
+]
+
+#: LRU bound on retained program records: a record is a few hundred bytes
+#: of host metadata (plus, until analyzed, the jitted fn reference that
+#: already lives in the program cache), so the bound exists for hygiene,
+#: not memory pressure.
+_MAX_RECORDS = 256
+
+
+def _to_avals(tree):
+    """Replace every array-like leaf with a ``ShapeDtypeStruct`` so the
+    record pins NO device buffers; non-array leaves (static config args)
+    pass through for re-lowering."""
+    import jax
+
+    def leaf(x):
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is not None and dtype is not None:
+            return jax.ShapeDtypeStruct(tuple(shape), dtype)
+        return x
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+def _scalar(value) -> Optional[float]:
+    try:
+        f = float(value)
+    except (TypeError, ValueError):
+        return None
+    return f
+
+
+class ProgramRecord:
+    """One distinct compiled program: identity, call accounting, and the
+    XLA analysis once :meth:`ProgramInventory.analyze_pending` ran."""
+
+    __slots__ = (
+        "tag", "signature", "first_ts", "last_ts", "calls", "build_s",
+        "first_call_s", "total_call_s", "analysis", "analysis_error",
+        "_fn", "_args", "_kwargs",
+    )
+
+    def __init__(self, tag: str, signature: tuple, fn, args, kwargs,
+                 call_s: float, build_s: Optional[float]):
+        now = time.time()
+        self.tag = tag
+        self.signature = signature
+        self.first_ts = now
+        self.last_ts = now
+        self.calls = 1
+        self.build_s = build_s
+        self.first_call_s = call_s
+        self.total_call_s = call_s
+        self.analysis: Optional[Dict[str, float]] = None
+        self.analysis_error: Optional[str] = None
+        self._fn = fn
+        self._args = _to_avals(args)
+        self._kwargs = _to_avals(kwargs) if kwargs else {}
+
+    @property
+    def status(self) -> str:
+        if self.analysis is not None:
+            return "analyzed"
+        if self.analysis_error is not None:
+            return "unavailable"
+        return "pending"
+
+    def row(self) -> Dict[str, Any]:
+        """JSON-ready ``/programz`` row (docs/operator.md#programz)."""
+        out: Dict[str, Any] = {
+            "tag": self.tag,
+            "signature": [list(s) for s in self.signature],
+            "calls": self.calls,
+            "first_ts": self.first_ts,
+            "last_ts": self.last_ts,
+            "first_call_s": self.first_call_s,
+            "total_call_s": self.total_call_s,
+            "status": self.status,
+        }
+        if self.build_s is not None:
+            out["build_s"] = self.build_s
+        if self.analysis:
+            out.update(self.analysis)
+        if self.analysis_error:
+            out["analysis_error"] = self.analysis_error
+        return out
+
+    def _analyze(self, deep: bool) -> None:
+        """Lower from the stored avals and pull XLA's cost model; with
+        ``deep`` also compile for ``memory_analysis()`` (one extra backend
+        compile per program — never on the sampler path)."""
+        fn, args, kwargs = self._fn, self._args, self._kwargs
+        if fn is None:
+            self.analysis_error = "program reference already released"
+            return
+        out: Dict[str, float] = {}
+        try:
+            lowered = fn.lower(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 - analysis is best-effort
+            self.analysis_error = f"lower failed: {type(e).__name__}: {e}"
+            return
+        cost = None
+        try:
+            cost = lowered.cost_analysis()
+        except Exception:  # noqa: BLE001 - backend without cost analysis
+            cost = None
+        compiled = None
+        if deep or cost is None:
+            try:
+                compiled = lowered.compile()
+            except Exception as e:  # noqa: BLE001
+                if cost is None:
+                    self.analysis_error = (
+                        f"compile failed: {type(e).__name__}: {e}"
+                    )
+                    return
+        if cost is None and compiled is not None:
+            try:
+                cost = compiled.cost_analysis()
+            except Exception:  # noqa: BLE001
+                cost = None
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        if cost:
+            flops = _scalar(cost.get("flops"))
+            if flops is not None and flops >= 0:
+                out["flops"] = flops
+            nbytes = _scalar(cost.get("bytes accessed"))
+            if nbytes is not None and nbytes >= 0:
+                out["bytes_accessed"] = nbytes
+        if compiled is not None:
+            mem = None
+            try:
+                mem = compiled.memory_analysis()
+            except Exception:  # noqa: BLE001 - cpu backends return None
+                mem = None
+            if mem is not None:
+                hbm = 0.0
+                for attr, key in (
+                    ("argument_size_in_bytes", "argument_bytes"),
+                    ("output_size_in_bytes", "output_bytes"),
+                    ("temp_size_in_bytes", "temp_bytes"),
+                    ("generated_code_size_in_bytes", "generated_code_bytes"),
+                ):
+                    v = _scalar(getattr(mem, attr, None))
+                    if v is not None:
+                        out[key] = v
+                        if key != "generated_code_bytes":
+                            hbm += v
+                if hbm > 0:
+                    out["peak_hbm_bytes"] = hbm
+        if out:
+            self.analysis = out
+            # the record is now self-contained: release the program and
+            # aval references so the inventory never extends a model's
+            # lifetime past its analysis
+            self._fn = None
+            self._args = None
+            self._kwargs = None
+        else:
+            self.analysis_error = (
+                "backend reported no cost analysis for this program"
+            )
+
+
+class ProgramInventory:
+    """Process-wide program inventory; installed as the models/base
+    program sink by :func:`enable` and scraped by ``/programz``."""
+
+    def __init__(self, max_records: int = _MAX_RECORDS):
+        self._lock = threading.Lock()
+        self._records: "OrderedDict[Tuple[str, tuple], ProgramRecord]" = (
+            OrderedDict()
+        )
+        self._max = int(max_records)
+        self._tls = threading.local()
+        self._calls = 0
+
+    # -- capture (the models/base sink) -----------------------------------
+
+    def record_call(self, tag: str, sig: tuple, fn, args, kwargs,
+                    call_s: float, build_s: Optional[float]) -> None:
+        key = (tag, sig)
+        with self._lock:
+            self._calls += 1
+            rec = self._records.get(key)
+            if rec is not None:
+                rec.calls += 1
+                rec.last_ts = time.time()
+                rec.total_call_s += call_s
+                self._records.move_to_end(key)
+                self._tls.last = rec
+                return
+        # miss: building the aval tree allocates, so do it off-lock
+        rec = ProgramRecord(tag, sig, fn, args, kwargs, call_s, build_s)
+        with self._lock:
+            existing = self._records.get(key)
+            if existing is not None:
+                existing.calls += 1
+                existing.total_call_s += call_s
+                rec = existing
+            else:
+                self._records[key] = rec
+                while len(self._records) > self._max:
+                    self._records.popitem(last=False)
+            self._tls.last = rec
+        self._publish_gauges()
+
+    def last_program_record(self) -> Optional[ProgramRecord]:
+        """The most recent program call recorded on THIS thread — how the
+        round ledger joins a chunk's ``round_end`` rows to the chunk
+        program it just dispatched."""
+        return getattr(self._tls, "last", None)
+
+    # -- analysis ---------------------------------------------------------
+
+    def analyze_pending(self, limit: Optional[int] = None,
+                        deep: bool = False) -> int:
+        """Run XLA analysis on up to ``limit`` pending records; returns
+        the number analyzed (or marked unavailable).  ``deep=False``
+        performs zero backend compiles (see module docstring)."""
+        with self._lock:
+            pending = [
+                r for r in self._records.values() if r.status == "pending"
+            ]
+        if limit is not None:
+            pending = pending[: max(int(limit), 0)]
+        done = 0
+        for rec in pending:
+            rec._analyze(deep)
+            done += 1
+        if done:
+            self._publish_gauges()
+        return done
+
+    # -- consumption ------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def records(self) -> List[ProgramRecord]:
+        with self._lock:
+            return list(self._records.values())
+
+    def rows(self, top: Optional[int] = None) -> List[Dict[str, Any]]:
+        """``/programz`` rows, heaviest first (XLA flops, then calls);
+        pure rendering of stored state — never traces or compiles."""
+        rows = [r.row() for r in self.records()]
+        rows.sort(
+            key=lambda r: (-float(r.get("flops", 0.0)), -r["calls"], r["tag"])
+        )
+        if top is not None:
+            rows = rows[: max(int(top), 0)]
+        return rows
+
+    def summary(self) -> Dict[str, Any]:
+        recs = self.records()
+        with self._lock:
+            calls = self._calls
+        return {
+            "programs": len(recs),
+            "calls": calls,
+            "analyzed": sum(1 for r in recs if r.status == "analyzed"),
+            "pending": sum(1 for r in recs if r.status == "pending"),
+            "unavailable": sum(1 for r in recs if r.status == "unavailable"),
+        }
+
+    def emit_rows(self, top: Optional[int] = None,
+                  path: Optional[str] = None) -> int:
+        """Emit one ``program`` telemetry event per ``/programz`` row into
+        the active JSONL sink — how an inventory snapshot lands next to
+        ``fleet_slo`` rows so ``tools/telemetry_report.py`` can render its
+        per-program table offline.  Returns the number emitted."""
+        from spark_ensemble_tpu.telemetry.events import emit_event
+
+        rows = self.rows(top=top)
+        for row in rows:
+            emit_event("program", path=path, **row)
+        return len(rows)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._calls = 0
+            self._tls = threading.local()
+
+    def _publish_gauges(self) -> None:
+        from spark_ensemble_tpu.telemetry.events import global_metrics
+
+        s = self.summary()
+        reg = global_metrics()
+        reg.gauge("programz/programs").set(s["programs"])
+        reg.gauge("programz/analyzed").set(s["analyzed"])
+        reg.gauge("programz/pending").set(s["pending"])
+
+
+_GLOBAL_INVENTORY = ProgramInventory()
+
+
+def global_inventory() -> ProgramInventory:
+    """The process-global inventory (what /programz serves)."""
+    return _GLOBAL_INVENTORY
+
+
+def enable() -> ProgramInventory:
+    """Install the global inventory as the models/base program sink.
+    Programs fetched BEFORE enabling stay invisible — enable the operator
+    plane before fitting/serving (telemetry/exporter.py does this)."""
+    from spark_ensemble_tpu.models.base import set_program_sink
+
+    set_program_sink(_GLOBAL_INVENTORY.record_call)
+    return _GLOBAL_INVENTORY
+
+
+def disable() -> None:
+    from spark_ensemble_tpu.models.base import set_program_sink
+
+    set_program_sink(None)
+
+
+def enabled() -> bool:
+    from spark_ensemble_tpu.models.base import _PROGRAM_SINK
+
+    return _PROGRAM_SINK[0] is not None
+
+
+# ---------------------------------------------------------------------------
+# round-ledger join (telemetry/events.py round_chunk)
+# ---------------------------------------------------------------------------
+
+
+def xla_cost_fields(round_cost: Optional[Dict[str, Any]],
+                    per_round_s: float,
+                    rounds_per_dispatch: int) -> Dict[str, Any]:
+    """The XLA leg of the three-way ``round_end`` cost line, joined from
+    this thread's last recorded program call (the chunk program the round
+    driver just fenced).  Empty until the record is analyzed — the
+    sampler analyzes in the background, so later chunks of the same fit
+    pick the fields up.  Never raises; never lowers or compiles."""
+    rec = _GLOBAL_INVENTORY.last_program_record()
+    if rec is None or not rec.analysis:
+        return {}
+    rounds = max(int(rounds_per_dispatch), 1)
+    fields: Dict[str, Any] = {"program_tag": rec.tag}
+    flops = rec.analysis.get("flops")
+    nbytes = rec.analysis.get("bytes_accessed")
+    if flops:
+        per_round_flops = flops / rounds
+        fields["xla_flops"] = per_round_flops
+        peak = (round_cost or {}).get("peak_flops")
+        if peak and per_round_s > 0:
+            fields["mfu_xla"] = per_round_flops / (per_round_s * float(peak))
+        if peak:
+            modeled = per_round_flops / float(peak)
+            bw = (round_cost or {}).get("hbm_bw_est")
+            if bw and nbytes:
+                modeled = max(modeled, (nbytes / rounds) / float(bw))
+            fields["xla_modeled_s"] = modeled
+        flops_est = (round_cost or {}).get("flops_est")
+        if flops_est:
+            fields["xla_vs_analytic_flops_ratio"] = (
+                per_round_flops / float(flops_est)
+            )
+    if nbytes:
+        fields["xla_bytes_accessed"] = nbytes / rounds
+    peak_hbm = rec.analysis.get("peak_hbm_bytes")
+    if peak_hbm:
+        fields["xla_peak_hbm_bytes"] = peak_hbm
+    return fields
+
+
+# ---------------------------------------------------------------------------
+# background HBM-watermark sampler
+# ---------------------------------------------------------------------------
+
+
+class HbmSampler:
+    """Daemon thread sampling per-device allocator stats into
+    ``global_metrics()`` gauges (``hbm/<dev>/bytes_in_use`` and the
+    process-lifetime ``hbm/<dev>/watermark_bytes``) and draining pending
+    program analysis (shallow — zero backend compiles) off the hot path.
+    CPU backends without allocator stats still get the analysis drain;
+    the gauges simply stay absent, matching ``device_memory_stats()``."""
+
+    def __init__(self, interval_s: float = 1.0, analyze: bool = True,
+                 analyze_per_tick: int = 1,
+                 inventory: Optional[ProgramInventory] = None):
+        self.interval_s = float(interval_s)
+        self._analyze = bool(analyze)
+        self._per_tick = int(analyze_per_tick)
+        self._inventory = inventory or _GLOBAL_INVENTORY
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._watermarks: Dict[str, float] = {}
+        self.ticks = 0
+
+    def sample_once(self) -> Dict[str, Dict[str, int]]:
+        from spark_ensemble_tpu.telemetry.events import (
+            device_memory_stats,
+            global_metrics,
+        )
+
+        reg = global_metrics()
+        stats = device_memory_stats()
+        for dev, s in stats.items():
+            in_use = float(s.get("bytes_in_use", 0))
+            reg.gauge(f"hbm/{dev}/bytes_in_use").set(in_use)
+            mark = max(
+                self._watermarks.get(dev, 0.0),
+                in_use,
+                float(s.get("peak_bytes_in_use", 0)),
+            )
+            self._watermarks[dev] = mark
+            reg.gauge(f"hbm/{dev}/watermark_bytes").set(mark)
+        if self._analyze:
+            self._inventory.analyze_pending(limit=self._per_tick, deep=False)
+        self.ticks += 1
+        return stats
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sample_once()
+            except Exception:  # noqa: BLE001 - sampling must never kill
+                pass  # the thread; next tick retries
+
+    def start(self) -> "HbmSampler":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="se-tpu-hbm-sampler", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None

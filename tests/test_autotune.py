@@ -447,6 +447,46 @@ def test_pvary_like_shard_handles_all_jax_spellings(monkeypatch):
     assert pvary_like_shard(x, "data") is x  # neither: no-op, no raise
 
 
+def test_pzero_like_shard_tracks_the_same_seam(monkeypatch):
+    """The zero-accumulator seed must ride the same pcast/pvary presence
+    chain and fall back to a psum of zeros on check_rep-era jax (no
+    varying-axes spelling at all) — value-identical, replication-typed."""
+    import jax
+    import numpy as np
+
+    from spark_ensemble_tpu.ops.collective import pzero_like_shard
+
+    x = np.ones(3, np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(pzero_like_shard(x, None)), np.zeros(3, np.float32)
+    )  # unsharded: plain zeros_like
+
+    seen = {}
+
+    def fake_pcast(v, names, to):
+        seen["pcast"] = (names, to)
+        return v
+
+    monkeypatch.setattr(jax.lax, "pcast", fake_pcast, raising=False)
+    monkeypatch.setattr(jax.lax, "pvary", None, raising=False)
+    out = pzero_like_shard(x, "data")
+    assert seen == {"pcast": (("data",), "varying")}
+    np.testing.assert_array_equal(np.asarray(out), np.zeros(3, np.float32))
+
+    # neither spelling: the psum-of-zeros fallback must be taken instead
+    seen.clear()
+    monkeypatch.delattr(jax.lax, "pcast", raising=False)
+    monkeypatch.delattr(jax.lax, "pvary", raising=False)
+    def fake_psum(v, a):
+        seen["psum"] = a
+        return v
+
+    monkeypatch.setattr(jax.lax, "psum", fake_psum)
+    out = pzero_like_shard(x, "data")
+    assert seen == {"psum": "data"}
+    np.testing.assert_array_equal(np.asarray(out), np.zeros(3, np.float32))
+
+
 def test_enable_compilation_cache_unlatches_stale_init(tmp_path, monkeypatch):
     """jax latches its persistent-cache state at the process's FIRST
     compile; enabling after an early compile must reset the latch so the

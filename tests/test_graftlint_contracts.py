@@ -102,6 +102,16 @@ def test_streaming_budgets_traced(traced):
     assert "gbm_classifier.fit_streaming" not in traced.skipped
 
 
+def test_operator_budgets_traced(traced):
+    # the live operator plane (docs/operator.md) pins TWO zeros: a full
+    # scrape (OpenMetrics render + /programz rows + a watchdog tick)
+    # dispatches no cached programs, and the watchdog/exporter sources
+    # carry no unfenced blocking reads — the empty violation list above
+    # IS both contracts; here pin that they traced and landed at zero
+    assert traced.budgets["operator.scrape"] == 0
+    assert traced.budgets["operator.lint"] == 0
+
+
 def test_distributed_budget_traced(traced):
     # the pod-scale elastic plane (parallel/elastic.py) pins ONE program
     # inventory across mesh widths AND shard counts: the tracer runs the

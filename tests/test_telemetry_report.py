@@ -47,6 +47,39 @@ def test_span_rows_do_not_leak_into_the_report():
     assert "round_chunk" not in rendered
 
 
+def test_canned_inventory_stream_renders_cost_triangle(capsys):
+    """The operator-plane extension of the golden (docs/operator.md): the
+    round_end rows carry the programz join fields and the stream holds
+    ``program`` inventory rows, so the report must render the three-way
+    cost line and the per-program top-N table — byte-pinned above, shape-
+    pinned here so a refactor cannot silently drop either section."""
+    assert report.main([CANNED]) == 0
+    text = capsys.readouterr().out
+    assert ("xla cost: measured 50.00ms/round  analytic 40.00ms/round  "
+            "xla 2.40ms/round  mfu_xla 0.48%  xla/analytic flops 0.94"
+            ) in text
+    assert "== programz ==" in text
+    # heaviest program first, pending rows keep a '-' build column
+    table = text.split("== programz ==")[1]
+    assert table.index("gbm_round") < table.index("predict:raw")
+    assert table.index("predict:raw") < table.index("gbm_sampling_plan")
+    assert "pending" in table
+
+
+def test_program_table_dedupes_reemitted_rows():
+    """Long-running streams re-emit inventory snapshots; only the latest
+    row per (tag, signature) may land in the table."""
+    rows = [
+        {"event": "program", "tag": "t", "signature": [["8", "f32"]],
+         "calls": 1, "flops": 10.0, "status": "pending"},
+        {"event": "program", "tag": "t", "signature": [["8", "f32"]],
+         "calls": 5, "flops": 10.0, "status": "analyzed"},
+    ]
+    table = report.program_table(rows)
+    assert table.count("\n") == 1  # header + exactly one data row
+    assert "analyzed" in table and "pending" not in table
+
+
 def test_fit_filter_and_aggregate_jsonl(tmp_path, capsys):
     out = tmp_path / "agg.jsonl"
     assert report.main([CANNED, "--fit", "GBMRegressor",

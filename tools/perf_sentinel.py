@@ -66,6 +66,13 @@ METRICS: Dict[str, Any] = {
     # roofline error (a model-quality tripwire, not a perf number)
     "pod_skew_ratio":        ("lower", 0.50, 0.25),
     "cost_model_error_pct":  ("lower", 0.50, 10.0),
+    # live operator plane (docs/operator.md): scrape-under-load fit delta
+    # vs the adjacent quiet fit (must stay ~free; wide rel floor because
+    # it is a difference of two noisy walls, 1.0 abs = the <1% budget),
+    # and the XLA-vs-analytic per-round flop ratio on the GBM letter leg
+    # (a cost-model drift tripwire: either model changing moves it)
+    "exporter_overhead_pct":      ("lower", 0.50, 1.0),
+    "xla_vs_analytic_cost_ratio": ("lower", 0.50, 0.25),
 }
 
 

@@ -26,7 +26,11 @@ configures (SE_TPU_CHAOS + serving faults):
         replica mid-stream ON TOP of any env-injected faults, and assert
         ZERO failed requests, zero steady-state compiles, and exact
         ensemble-prefix degradation.  The per-replica SLO rows land in
-        the --telemetry JSONL.
+        the --telemetry JSONL.  With ``--operator DIR`` the live operator
+        plane (docs/operator.md) runs over the battery: /metrics and
+        /programz are scraped mid-load and validated, a deterministic
+        stall+crash window must flip /healthz to 503 (and recovery must
+        flip it back), and the validated snapshot files land in DIR.
 
 Exit code 0 = every assertion held; any mismatch raises.
 """
@@ -146,6 +150,92 @@ def cmd_serve(args):
     }))
 
 
+def _fetch(url):
+    """GET a local operator endpoint; returns (status, body) and never
+    raises on HTTP error codes (a 503 /healthz is data, not a failure)."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _operator_chaos_window(args, plane, router, X, tier_pred, full_pred):
+    """The deterministic /healthz flip (docs/operator.md): a clean-window
+    200, a forced 503 while every request stalls past the SLO and a
+    replica dies, and a 200 again once the stalls wash out of the
+    rolling latency window.  Watchdog ticks are driven explicitly so the
+    flip does not depend on runner timing."""
+    from spark_ensemble_tpu.robustness.chaos import ChaosController, install
+    from spark_ensemble_tpu.telemetry.exporter import write_snapshot
+
+    dog = plane.watchdog
+    # install(None) reverts to the ENV controller (SE_TPU_CHAOS is live in
+    # the CI job), so the quiet phases need an explicit never-fires
+    # controller — rate 0.0 draws never beat the threshold
+    quiet = ChaosController(seed=0, rate=0.0)
+
+    def batch(count, size=16):
+        for _ in range(count):
+            resp = router.predict(X[:size], deadline_ms=10_000.0)
+            want = tier_pred if resp.degraded else full_pred
+            assert np.allclose(resp.value, want[:size], rtol=1e-5,
+                               atol=1e-6)
+
+    # healthy phase: wash the rolling window clean of whatever the
+    # env-chaos battery left in it (the deque holds 256 samples), then
+    # the p99 probe reads a fast-request window and /healthz must be 200
+    install(quiet)
+    batch(300)
+    dog.evaluate_once()
+    dog.evaluate_once()
+    code, body = _fetch(plane.url + "/healthz")
+    assert code == 200, (code, body)
+
+    # degradation window: EVERY request stalls well past --slo-p99-ms
+    # AND a replica dies mid-window; breach_for=1 means one tick flips
+    # the verdict, and the alert must name the p99 rule
+    install(ChaosController(seed=7, rate=1.0, faults=("replica_stall",)))
+    router.kill_replica()
+    batch(12)
+    dog.evaluate_once()
+    code, body = _fetch(plane.url + "/healthz")
+    assert code == 503, (code, body)
+    verdict = json.loads(body)
+    assert verdict["status"] == "degraded", verdict
+    assert any(a["metric"] == "serving_p99_ms"
+               for a in verdict["alerts"]), verdict
+
+    # recovery: faults off, fast requests push the stalls out of the
+    # window, clear_for=2 healthy ticks emit the cleared slo_alert and
+    # /healthz goes green again
+    install(quiet)
+    batch(300)
+    dog.evaluate_once()
+    dog.evaluate_once()
+    code, body = _fetch(plane.url + "/healthz")
+    assert code == 200, (code, body)
+    install(None)  # hand the env-configured controller back
+
+    # inventory rows into the telemetry stream (trace + report join) and
+    # the validated snapshot files for the CI artifact upload
+    plane.inventory.analyze_pending()
+    programs = plane.inventory.emit_rows(path=args.telemetry)
+    paths = write_snapshot(args.operator, inventory=plane.inventory,
+                           watchdog=dog)
+    return {
+        "url": plane.url,
+        "snapshot": paths,
+        "healthz_flip": ["ok", "degraded", "ok"],
+        "alert_metric": "serving_p99_ms",
+        "slo_p99_ms": float(args.slo_p99_ms),
+        "programs_emitted": programs,
+    }
+
+
 def cmd_fleet(args):
     import threading
 
@@ -153,6 +243,37 @@ def cmd_fleet(args):
 
     expected = np.load(os.path.join(args.out, "expected.npz"))
     X = expected["X"]
+
+    plane = None
+    operator_report = {}
+    if args.operator:
+        os.makedirs(args.operator, exist_ok=True)
+        # live operator plane (docs/operator.md), started BEFORE the model
+        # loads so the fleet's warmup programs land in /programz.  The
+        # watchdog gets one deterministic rule — fleet p99 against
+        # --slo-p99-ms with single-tick raise hysteresis — so the
+        # degradation flip below is driven by the injected stalls, not by
+        # runner-speed luck against the production thresholds.
+        from spark_ensemble_tpu.telemetry.exporter import OperatorPlane
+        from spark_ensemble_tpu.telemetry.watchdog import (
+            Rule,
+            Watchdog,
+            probe_fleet_max,
+        )
+
+        dog = Watchdog(
+            rules=[Rule(
+                "serving_p99_ms", probe_fleet_max("p99_ms"),
+                threshold=float(args.slo_p99_ms),
+                breach_for=1, clear_for=2,
+            )],
+            interval_s=3600.0,  # ticked explicitly below, deterministic
+            telemetry_path=args.telemetry,
+        )
+        plane = OperatorPlane(
+            port=0, watchdog=dog, sampler_interval_s=0.1
+        ).start()
+
     packed = load_packed(os.path.join(args.out, "model"))
     tier = max(1, packed.num_members // 2)
     # prefix exactness, pinned BEFORE the fleet warms: the degraded tier
@@ -198,6 +319,34 @@ def cmd_fleet(args):
     ]
     for t in threads:
         t.start()
+    if plane is not None:
+        # scrape WHILE the battery (and its deterministic kill) is in
+        # flight: the exposition must validate under load, and the raw
+        # bodies become CI artifacts.  Zero-new-compiles is re-asserted
+        # on a post-window snapshot below.
+        import time as _time
+
+        from spark_ensemble_tpu.telemetry.exporter import (
+            validate_openmetrics,
+        )
+
+        _time.sleep(0.2)  # let the workers get requests in flight
+        code, metrics_text = _fetch(plane.url + "/metrics")
+        assert code == 200, code
+        problems = validate_openmetrics(metrics_text)
+        assert not problems, problems[:5]
+        code, programz_body = _fetch(plane.url + "/programz?n=10")
+        assert code == 200, code
+        with open(os.path.join(args.operator, "metrics_midload.txt"),
+                  "w") as f:
+            f.write(metrics_text)
+        with open(os.path.join(args.operator, "programz_midload.json"),
+                  "w") as f:
+            f.write(programz_body)
+        operator_report["midload_scrape"] = {
+            "metrics_bytes": len(metrics_text),
+            "programs": len(json.loads(programz_body)["programs"]),
+        }
     for t in threads:
         t.join(timeout=600)
 
@@ -217,6 +366,16 @@ def cmd_fleet(args):
     assert statusz["requests"] == snap["requests"]
     assert 0.0 <= statusz["hedge_rate"] <= 1.0
     assert statusz["trace_id"]
+    if plane is not None:
+        operator_report.update(
+            _operator_chaos_window(args, plane, router, X, tier_pred,
+                                   full_pred)
+        )
+        # the whole operator battery — scrapes under load, the stall
+        # window, the recovery washes — must not have compiled anything
+        post = router.slo_snapshot()
+        assert post["compiles_since_warmup"] == 0, post
+        plane.stop()
     router.stop()  # emits the fleet_slo rows to --telemetry
     assert failed[0] == 0, f"{failed[0]} requests failed under faults"
     assert snap["compiles_since_warmup"] == 0, snap
@@ -237,6 +396,7 @@ def cmd_fleet(args):
         },
         "pid": os.getpid(),
         "telemetry": args.telemetry,
+        "operator": operator_report or None,
     }))
 
 
@@ -261,6 +421,19 @@ def main(argv=None):
     p_fleet.add_argument("--telemetry", default=None)
     p_fleet.add_argument("--replicas", type=int, default=3)
     p_fleet.add_argument("--requests", type=int, default=200)
+    p_fleet.add_argument(
+        "--operator", metavar="DIR", default=None,
+        help="also run the live operator plane (docs/operator.md): scrape "
+        "/metrics + /programz mid-battery, force a deterministic /healthz "
+        "503 during a stall+crash window, assert recovery, and write the "
+        "validated snapshot files into DIR (the CI artifact)",
+    )
+    p_fleet.add_argument(
+        "--slo-p99-ms", type=float, default=100.0,
+        help="p99 threshold for the --operator watchdog rule; the chaos "
+        "window stalls every request 250 ms so any value well under that "
+        "flips deterministically",
+    )
     p_fleet.set_defaults(fn=cmd_fleet)
     args = parser.parse_args(argv)
     os.makedirs(args.out, exist_ok=True)

@@ -173,6 +173,82 @@ def cost_model_line(fit_events: List[dict]) -> Optional[str]:
     return "  ".join(parts)
 
 
+def xla_cost_line(fit_events: List[dict]) -> Optional[str]:
+    """The three-way cost line (docs/operator.md): measured wall vs the
+    analytic roofline (``modeled_s``) vs XLA's own cost model
+    (``xla_modeled_s``), with MFU recomputed from XLA flops and the
+    XLA/analytic flop ratio.  Only fits whose round_end events carry the
+    programz join fields (telemetry/programz.py live + analyzed) get it."""
+    ends = [
+        e
+        for e in fit_events
+        if e.get("event") == "round_end" and "xla_flops" in e
+    ]
+    if not ends:
+        return None
+
+    def med(key: str) -> Optional[float]:
+        vals = sorted(float(e[key]) for e in ends if key in e)
+        return vals[len(vals) // 2] if vals else None
+
+    measured = med("duration_s")
+    analytic = med("modeled_s")
+    xla = med("xla_modeled_s")
+    parts = []
+    if measured is not None:
+        parts.append(f"measured {measured * 1e3:.2f}ms/round")
+    if analytic is not None:
+        parts.append(f"analytic {analytic * 1e3:.2f}ms/round")
+    if xla is not None:
+        parts.append(f"xla {xla * 1e3:.2f}ms/round")
+    mfu = med("mfu_xla")
+    if mfu is not None:
+        parts.append(f"mfu_xla {100.0 * mfu:.2f}%")
+    ratio = med("xla_vs_analytic_flops_ratio")
+    if ratio is not None:
+        parts.append(f"xla/analytic flops {ratio:.2f}")
+    return "xla cost: " + "  ".join(parts)
+
+
+def program_table(events: List[dict], top: int = 10) -> Optional[str]:
+    """Per-program top-N table from ``program`` events — the
+    ``/programz`` rows an operator plane emitted into the stream
+    (``ProgramInventory.emit_rows`` / ``serving_smoke.py fleet``).
+    Heaviest program first (XLA flops, then calls), one row each."""
+    rows = [e for e in events if e.get("event") == "program"]
+    if not rows:
+        return None
+    # the inventory re-emits on every snapshot: keep the last row per
+    # (tag, signature) so long-running streams do not duplicate programs
+    latest: Dict[Tuple[str, str], dict] = {}
+    for e in rows:
+        latest[(e.get("tag", "?"), json.dumps(e.get("signature")))] = e
+    ordered = sorted(
+        latest.values(),
+        key=lambda e: (
+            -float(e.get("flops", 0.0)),
+            -int(e.get("calls", 0)),
+            e.get("tag", "?"),
+        ),
+    )[: max(int(top), 0)]
+    lines = [
+        f"{'gflops':>8}  {'MiB':>8}  {'calls':>6}  {'build_ms':>9}  "
+        f"{'status':<11} tag"
+    ]
+    for e in ordered:
+        flops = float(e.get("flops", 0.0))
+        nbytes = float(e.get("bytes_accessed", 0.0))
+        build = e.get("build_s")
+        lines.append(
+            f"{flops / 1e9:>8.3f}  {nbytes / 2**20:>8.2f}  "
+            f"{int(e.get('calls', 0)):>6}  "
+            + (f"{float(build) * 1e3:>9.2f}  " if build is not None
+               else f"{'-':>9}  ")
+            + f"{e.get('status', '?'):<11} {e.get('tag', '?')}"
+        )
+    return "\n".join(lines)
+
+
 def shard_io_line(fit_events: List[dict]) -> Optional[str]:
     """Shard-I/O summary for streaming fits (data/streaming.py): bytes
     pulled through the prefetcher, prefetch hit rate, and — the number the
@@ -292,6 +368,9 @@ def render_fit(fit_id: str, fit_events: List[dict]) -> str:
     model = cost_model_line(fit_events)
     if model:
         lines.append(model)
+    xla = xla_cost_line(fit_events)
+    if xla:
+        lines.append(xla)
     shard_io = shard_io_line(fit_events)
     if shard_io:
         lines.append(shard_io)
@@ -384,6 +463,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 1
     for fit_id in sorted(fits):
         print(render_fit(fit_id, fits[fit_id]))
+        print()
+    programs = program_table(events)
+    if programs:
+        print("== programz ==")
+        print(programs)
         print()
     if streams is not None:
         skew = podview.skew_report(streams)

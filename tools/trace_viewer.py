@@ -57,7 +57,8 @@ def _load_podview():
     return mod
 
 #: standalone event types rendered as instant markers on their track
-INSTANT_EVENTS = ("hedge_fired", "replica_state", "request_shed")
+INSTANT_EVENTS = ("hedge_fired", "replica_state", "request_shed",
+                  "slo_alert")
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:
