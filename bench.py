@@ -667,6 +667,38 @@ def _bench_fleet(model, X, y, num_rounds):
                 ),
                 "shed": shed[0],
             }
+        # drift-sketch A/B (telemetry/quality.py): the same packed model
+        # served through warm programs with the fused histogram capture off
+        # vs on, identical request sequence, interleaved passes so shared
+        # machine noise cancels — the steady-state cost of the quality
+        # plane's sketch, which the sentinel floors at <2%
+        # (drift_overhead_pct, docs/quality.md#overhead)
+        drift_overhead_pct = None
+        packed = base.packed
+        if packed.quality is not None:
+            eng_off = InferenceEngine(
+                packed, min_bucket=32, max_batch_size=256,
+                label="bench-drift-off", drift=False,
+            )
+            eng_on = InferenceEngine(
+                packed, min_bucket=32, max_batch_size=256,
+                label="bench-drift-on", drift=True, drift_window=2048,
+            )
+            for eng in (eng_off, eng_on):
+                eng.predict(reqs[0])  # untimed touch of the served bucket
+            t_off = t_on = 0.0
+            for _ in range(2):
+                t0 = time.perf_counter()
+                for q in reqs:
+                    eng_off.predict(q)
+                t_off += time.perf_counter() - t0
+                t0 = time.perf_counter()
+                for q in reqs:
+                    eng_on.predict(q)
+                t_on += time.perf_counter() - t0
+            eng_off.stop()
+            eng_on.stop()
+            drift_overhead_pct = 100.0 * (t_on - t_off) / max(t_off, 1e-9)
         base.stop()
         return {
             "replicas": 2,
@@ -677,6 +709,11 @@ def _bench_fleet(model, X, y, num_rounds):
                 faulted["p99_ms"] / max(clean["p99_ms"], 1e-9), 3
             ),
             "open_loop": open_loop,
+            "drift_overhead_pct": (
+                round(drift_overhead_pct, 2)
+                if drift_overhead_pct is not None
+                else None
+            ),
         }
     except Exception as e:  # noqa: BLE001 - carry the error, keep going
         return {"error": str(e)[:200]}
@@ -1167,6 +1204,10 @@ def inner():
     out["serving_p99_ms"] = out["serving_queue_p99_ms"]
     out["compiles_since_warmup"] = serving_compiles
     out["host_blocked_share"] = pipeline_ab["pipelined_host_blocked_share"]
+    if isinstance(fleet_stats, dict) and isinstance(
+        fleet_stats.get("drift_overhead_pct"), (int, float)
+    ):
+        out["drift_overhead_pct"] = fleet_stats["drift_overhead_pct"]
     if platform != "cpu":
         # only meaningful against a real accelerator peak; a CPU "MFU"
         # against an invented 1 TFLOP/s nominal is noise, not evidence

@@ -92,6 +92,7 @@ from spark_ensemble_tpu.tuning import (
 )
 from spark_ensemble_tpu import telemetry
 from spark_ensemble_tpu.telemetry import (
+    DriftMonitor,
     FitTelemetry,
     FlightRecorder,
     HbmSampler,
@@ -100,6 +101,7 @@ from spark_ensemble_tpu.telemetry import (
     OperatorServer,
     ProgramInventory,
     ProgramRecord,
+    ShadowScorer,
     Span,
     TelemetryRecorder,
     TraceContext,
@@ -110,6 +112,7 @@ from spark_ensemble_tpu.telemetry import (
     record_fits,
     render_openmetrics,
     skew_report,
+    staged_attribution,
     start_operator_plane,
     stitch_files,
     trace_annotations_enabled,
@@ -253,6 +256,9 @@ __all__ = [
     "render_openmetrics",
     "start_operator_plane",
     "validate_openmetrics",
+    "DriftMonitor",
+    "ShadowScorer",
+    "staged_attribution",
     "ChaosController",
     "ChaosPreemption",
     "ChaosTransientError",

@@ -818,6 +818,133 @@ def _trace_operator(report: ContractReport) -> None:
         )
 
 
+def _trace_quality(report: ContractReport) -> None:
+    """Trace the model-quality plane's budget (docs/quality.md).
+
+    Three pins.  ``quality.warmup``: a drift-enabled engine warms EXACTLY
+    as many compiled programs as a drift-off twin — the bin sketch is
+    fused into the existing bucket programs, never compiled beside them.
+    ``quality.serve_dispatches_per_request``: serving warmed requests
+    with the sketch on stays ONE device dispatch per request (counted by
+    wrapping the engine's program cache — AOT programs bypass the
+    ``observe_program_calls`` chokepoint), performs zero backend
+    compiles, and returns outputs bit-identical to the drift-off twin.
+    ``quality.lint``: ``telemetry/quality.py`` carries no unfenced
+    blocking reads — linted with the absolute path, which bypasses the
+    blanket ``telemetry/`` fence-module exemption, because the drift
+    monitor runs inline on serving threads."""
+    from spark_ensemble_tpu.analysis.lint import lint_file
+    from spark_ensemble_tpu.serving.engine import InferenceEngine
+    from spark_ensemble_tpu.serving.export import pack
+    from spark_ensemble_tpu.telemetry import quality
+    from spark_ensemble_tpu.telemetry.events import compile_snapshot
+
+    import spark_ensemble_tpu as se
+
+    X, y = _canonical_data(False)
+    model = se.GBMRegressor(
+        base_learner=se.DecisionTreeRegressor(max_depth=3),
+        num_base_learners=3,
+        seed=0,
+    ).fit(X, y)
+    packed = pack(model)
+    if packed.quality is None:
+        report.violations.append(
+            ContractViolation(
+                "quality",
+                "quality.warmup",
+                "pack(model) carries no drift reference (PackedModel."
+                "quality is None) — fit must capture the bin occupancy "
+                "the sketch scores against",
+            )
+        )
+        return
+    off = InferenceEngine(
+        packed, methods=("predict",), min_bucket=8, max_batch_size=32,
+        warm=True, drift=False,
+    )
+    on = InferenceEngine(
+        packed, methods=("predict",), min_bucket=8, max_batch_size=32,
+        warm=True, drift=True,
+    )
+    try:
+        n_off, n_on = len(off._compiled), len(on._compiled)
+        report.budgets["quality.warmup"] = n_on
+        if n_on != n_off:
+            report.violations.append(
+                ContractViolation(
+                    "quality",
+                    "quality.warmup",
+                    f"drift-on engine warmed {n_on} programs vs {n_off} "
+                    "with drift off — the sketch must fuse into the "
+                    "existing bucket programs, not compile beside them",
+                )
+            )
+        sizes = (1, 7, 9, 30)
+        calls = [0]
+
+        def _counted(fn):
+            def inner(*a, **k):
+                calls[0] += 1
+                return fn(*a, **k)
+
+            return inner
+
+        on._compiled = {k: _counted(v) for k, v in on._compiled.items()}
+        before = compile_snapshot()[0]
+        for n in sizes:
+            got = on.predict(X[:n])
+            want = off.predict(X[:n])
+            if not np.array_equal(np.asarray(got), np.asarray(want)):
+                report.violations.append(
+                    ContractViolation(
+                        "quality",
+                        "quality.serve_dispatches_per_request",
+                        f"drift-on predictions diverge from the drift-off "
+                        f"twin at n={n} — the sketch must be a pure "
+                        "side-output, never touch the prediction",
+                    )
+                )
+                break
+        after = compile_snapshot()[0]
+        per, rem = divmod(calls[0], len(sizes))
+        report.budgets["quality.serve_dispatches_per_request"] = (
+            per if not rem else calls[0]
+        )
+        if rem or after != before:
+            report.violations.append(
+                ContractViolation(
+                    "quality",
+                    "quality.serve_dispatches_per_request",
+                    f"{calls[0]} dispatch(es) and {after - before} backend "
+                    f"compile(s) serving {len(sizes)} warmed drift-on "
+                    "requests (must be one dispatch per request, zero "
+                    "compiles)",
+                )
+            )
+    finally:
+        on.stop()
+        off.stop()
+    findings = [
+        f
+        for f in lint_file(
+            os.path.abspath(quality.__file__),
+            select=["unfenced-blocking-read"],
+        )
+        if not f.suppressed
+    ]
+    report.budgets["quality.lint"] = len(findings)
+    for f in findings:
+        report.violations.append(
+            ContractViolation(
+                "quality",
+                "quality.lint",
+                f"unfenced blocking read on the quality plane: "
+                f"{f.path}:{f.line}: {f.message}",
+            )
+        )
+
+
 def trace_contracts(
     entry_points: Optional[List[str]] = None,
 ) -> ContractReport:
@@ -846,6 +973,8 @@ def trace_contracts(
             _trace_tracing(report)
         if wanted is None or "operator" in wanted:
             _trace_operator(report)
+        if wanted is None or "quality" in wanted:
+            _trace_quality(report)
     return report
 
 

@@ -92,6 +92,7 @@ from spark_ensemble_tpu.parallel.mesh import (
 )
 from spark_ensemble_tpu.params import Param, gt, gt_eq, in_array, in_range
 from spark_ensemble_tpu.telemetry.events import FitTelemetry
+from spark_ensemble_tpu.telemetry.quality import drift_reference_from_ctx
 from spark_ensemble_tpu.utils.instrumentation import (
     Instrumentation,
     instrumented_fit,
@@ -838,6 +839,10 @@ class GBMRegressor(_GBMParams):
         # observe later set_params mutations of the caller's instance
         base = self._base().copy()
         ctx = make_shared_fit_ctx(base, X)
+        # training-time drift reference (telemetry/quality.py): thresholds +
+        # per-feature bin occupancy, read from the binned ctx BEFORE row
+        # sharding pads it — pure host bincounts, no extra compiled program
+        drift_ref = drift_reference_from_ctx(ctx)
         bag_keys, masks = self._sampling_plan(n, d)
 
         init_model = self._fit_init(X, y, w, mesh=mesh)
@@ -1265,6 +1270,8 @@ class GBMRegressor(_GBMParams):
             num_members=keep,
             **self.get_params(),
         )
+        if drift_ref is not None:
+            model.drift_ref_ = drift_ref
         telem.finish(model=model, rounds=i, kept_members=keep)
         return model
 
@@ -1401,6 +1408,9 @@ class GBMClassifier(_GBMParams):
         # observe later set_params mutations of the caller's instance
         base = self._base().copy()
         ctx = make_shared_fit_ctx(base, X)
+        # training-time drift reference (telemetry/quality.py): captured
+        # before row sharding pads the binned ctx; host-side bincounts only
+        drift_ref = drift_reference_from_ctx(ctx)
         bag_keys, masks = self._sampling_plan(n, d)
         loss = self._make_loss(num_classes)
         dim = loss.dim
@@ -1893,6 +1903,8 @@ class GBMClassifier(_GBMParams):
             dim=dim,
             **self.get_params(),
         )
+        if drift_ref is not None:
+            model.drift_ref_ = drift_ref
         telem.finish(model=model, rounds=i, kept_members=keep)
         return model
 

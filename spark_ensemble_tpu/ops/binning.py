@@ -53,6 +53,23 @@ def bin_features(X: jax.Array, bins: Bins) -> jax.Array:
     )
 
 
+def bin_occupancy(X: jax.Array, bins: Bins) -> jax.Array:
+    """``int32[d, max_bins]`` per-feature bin-count histogram of ``X``'s
+    rows under ``bins`` — the drift-sketch primitive
+    (telemetry/quality.py).
+
+    Counts are computed as a one-hot float sum and cast back to int32, so
+    they are EXACT integers (row counts stay far below the f32 mantissa),
+    which makes the sketch invariant to row order and to how a request
+    stream was split into batches: histograms of any partition of the same
+    rows sum to the histogram of the whole — the property the serving
+    engine's padded-bucket accumulation and the batching-order tests rely
+    on."""
+    ids = bin_features(X, bins)  # i32[n, d]
+    onehot = jax.nn.one_hot(ids, bins.max_bins, dtype=jnp.float32)
+    return jnp.sum(onehot, axis=0).astype(jnp.int32)  # [d, max_bins]
+
+
 # ---------------------------------------------------------------------------
 # Compressed (bit-packed) bin storage for the fused round kernel
 # ---------------------------------------------------------------------------

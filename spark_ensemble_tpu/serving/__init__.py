@@ -30,6 +30,12 @@ All of it emits ``model_packed`` / ``engine_warmup`` / ``request_served`` /
 ``fleet_request`` / ``replica_state`` / ``fleet_slo`` events through
 :mod:`spark_ensemble_tpu.telemetry`, so ``tools/telemetry_report.py``
 renders serving traces unchanged.
+
+The model-quality plane rides on top (docs/quality.md): packed models
+carry their fit-time bin reference (``PackedModel.quality``), engines fuse
+a per-feature drift sketch into the cached predict programs, and the fleet
+adds sampled staged attribution + shadow scoring
+(:mod:`spark_ensemble_tpu.telemetry.quality`).
 """
 
 from spark_ensemble_tpu.serving.export import (

@@ -38,7 +38,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from spark_ensemble_tpu.ops.binning import Bins, bin_occupancy
 from spark_ensemble_tpu.serving.export import PackedModel, pack, rebuild_model
+from spark_ensemble_tpu.telemetry.quality import DriftMonitor
 from spark_ensemble_tpu.telemetry.events import (
     _ensure_compile_listener,
     compile_snapshot,
@@ -108,6 +110,17 @@ class InferenceEngine:
     warm:
         AOT-compile + execute every (method, bucket) program at
         construction; pass ``False`` to warm explicitly later.
+    drift / drift_window / drift_monitor:
+        On-device feature-drift sketching (telemetry/quality.py,
+        docs/quality.md).  When the packed model carries its fit-time bin
+        reference (``PackedModel.quality``), the full-model predict
+        programs ALSO emit a per-feature bin-count histogram of the served
+        rows — fused into the same cached program, so steady-state serving
+        still performs zero compiles and zero extra dispatches — and a
+        :class:`DriftMonitor` scores tumbling ``drift_window``-row windows
+        as PSI/KL against the training occupancy.  ``drift=None`` enables
+        this exactly when the reference is present; ``drift_monitor``
+        injects a shared monitor (fleet replicas aggregate into one).
     """
 
     def __init__(
@@ -123,6 +136,9 @@ class InferenceEngine:
         label: str = "engine",
         telemetry_path: Optional[str] = None,
         prefix_tiers: Tuple[int, ...] = (),
+        drift: Optional[bool] = None,
+        drift_window: int = 2048,
+        drift_monitor: Optional[DriftMonitor] = None,
     ):
         self._packed = model if isinstance(model, PackedModel) else pack(model)
         if self._packed.num_features <= 0:
@@ -169,6 +185,30 @@ class InferenceEngine:
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                 self._tier_arrays[k],
             )
+        # on-device drift sketch: auto-on exactly when the packed model
+        # ships its fit-time reference; the monitor is shared by clones so
+        # a fleet's replicas aggregate into one window stream
+        quality = self._packed.quality
+        if drift is None:
+            drift = quality is not None
+        if drift and quality is None:
+            raise ValueError(
+                "drift=True but the packed model carries no fit-time drift "
+                "reference (PackedModel.quality is None); re-pack from a "
+                "fit that captured one, or pass drift=False"
+            )
+        self._drift_enabled = bool(drift)
+        self._drift = drift_monitor
+        self._drift_owner = False
+        if self._drift_enabled and self._drift is None:
+            self._drift = DriftMonitor(
+                quality["thresholds"],
+                quality["occupancy"],
+                window_rows=drift_window,
+                stream=self._stream,
+                telemetry_path=telemetry_path,
+            )
+            self._drift_owner = True
         self._metrics = global_metrics()
         self._queue: "queue_mod.SimpleQueue" = queue_mod.SimpleQueue()
         self._worker: Optional[threading.Thread] = None
@@ -187,6 +227,16 @@ class InferenceEngine:
     @property
     def prefix_tiers(self) -> Tuple[int, ...]:
         return self._prefix_tiers
+
+    @property
+    def packed(self) -> PackedModel:
+        return self._packed
+
+    @property
+    def drift_monitor(self) -> Optional[DriftMonitor]:
+        """The live drift monitor (shared across clones), or ``None`` when
+        sketching is disabled."""
+        return self._drift
 
     def clone(self, label: str) -> "InferenceEngine":
         """A fleet replica over the SAME compiled programs and device
@@ -214,6 +264,9 @@ class InferenceEngine:
         eng._tier_nodes = self._tier_nodes
         eng._tier_arrays = self._tier_arrays
         eng._tier_structs = self._tier_structs
+        eng._drift_enabled = self._drift_enabled
+        eng._drift = self._drift  # shared: replicas fold into one stream
+        eng._drift_owner = False
         eng._metrics = self._metrics
         eng._queue = queue_mod.SimpleQueue()
         eng._worker = None
@@ -254,12 +307,23 @@ class InferenceEngine:
         node = self._packed.node if not tier else self._tier_nodes[tier]
         struct = self._arrays_struct if not tier else self._tier_structs[tier]
         d = self._packed.num_features
+        # drift sketching rides ONLY the full-model programs: tier replays
+        # (staged attribution) re-serve rows the tier-0 path already counted
+        sketch = self._drift_enabled and not tier
 
         def run(arrays, X):
             # rebuild happens at trace time only: model construction is
             # pure pytree plumbing, so the whole model predict stages into
             # ONE program with the packed arrays as (non-donated) inputs
-            return getattr(rebuild_model(node, arrays), method)(X)
+            out = getattr(rebuild_model(node, arrays), method)(X)
+            if sketch:
+                # per-feature bin histogram of the request rows, fused into
+                # the SAME program: same compile count, same dispatch count
+                hist = bin_occupancy(
+                    X, Bins(thresholds=arrays["q.thresholds"])
+                )
+                return out, hist
+            return out
 
         jitted = jax.jit(run, donate_argnums=(1,) if self._donate else ())
         wall0 = time.time()
@@ -339,6 +403,14 @@ class InferenceEngine:
             buf[:n] = Xa
             Xa = buf
         out = compiled(self._arrays_for(tier), jnp.asarray(Xa))
+        if self._drift_enabled and not tier:
+            out, hist = out
+            res = np.asarray(out)[:n]
+            if self._drift is not None:
+                # one host transfer per dispatch, off the result's critical
+                # section; pad rows are subtracted inside the monitor
+                self._drift.observe(np.asarray(hist), pad_rows=b - n)
+            return res, b
         return np.asarray(out)[:n], b
 
     def _serve_rows(self, method: str, Xa: np.ndarray, tier: int = 0):
@@ -507,6 +579,8 @@ class InferenceEngine:
     def stop(self) -> None:
         """Drain and stop the queue worker (idempotent)."""
         self._stopped = True
+        if self._drift_owner and self._drift is not None:
+            self._drift.close()
         worker = self._worker
         if worker is not None and worker.is_alive():
             self._queue.put(_SHUTDOWN)
@@ -542,4 +616,8 @@ class InferenceEngine:
             "compiles_since_warmup": c - self._warm_snapshot[0],
             "compile_s_since_warmup": s - self._warm_snapshot[1],
             "packed_bytes": self._packed.nbytes,
+            "drift_enabled": self._drift_enabled,
+            "drift": (
+                self._drift.snapshot() if self._drift is not None else None
+            ),
         }

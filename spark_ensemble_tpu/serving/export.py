@@ -242,6 +242,24 @@ class PackedModel:
         m = self._node.get("extra", {}).get("num_members")
         return None if m is None else int(m)
 
+    @property
+    def quality(self) -> Optional[Dict[str, Any]]:
+        """The drift-reference sidecar captured at fit (host numpy):
+        ``{"thresholds": f32[d, B-1], "occupancy": i32[d, B], "rows": n}``,
+        or ``None`` when the model was packed without one (non-binned
+        families, or pre-quality artifacts).  ``rebuild_model`` never reads
+        this node, so its presence cannot perturb predictions."""
+        q = self._node.get("quality")
+        if not q:
+            return None
+        return {
+            "thresholds": np.asarray(
+                self._arrays[q["thresholds"]], np.float32
+            ),
+            "occupancy": np.asarray(self._arrays[q["occupancy"]], np.int32),
+            "rows": int(q.get("rows", 0)),
+        }
+
     # -- arrays ------------------------------------------------------------
 
     @property
@@ -326,7 +344,15 @@ class PackedModel:
             raise ValueError(
                 f"take(k={k}) out of range for an ensemble of {n} members"
             )
-        return pack(model.take(int(k)))
+        prefix = pack(model.take(int(k)))
+        # the live model's take() drops fit-time sidecars, so re-attach the
+        # drift reference: tier engines sketch against the same thresholds
+        q = self._node.get("quality")
+        if q:
+            prefix._node["quality"] = dict(q)
+            prefix._arrays[q["thresholds"]] = self._arrays[q["thresholds"]]
+            prefix._arrays[q["occupancy"]] = self._arrays[q["occupancy"]]
+        return prefix
 
     # -- persistence -------------------------------------------------------
 
@@ -397,6 +423,19 @@ def pack(model) -> PackedModel:
         )
     arrays: Dict[str, Any] = {}
     node = _encode_model(model, arrays, "m")
+    # model-quality sidecar (telemetry/quality.py): fitted bin thresholds +
+    # training bin occupancy ride along as ordinary packed arrays under a
+    # node key rebuild_model never reads, so predictions stay bit-identical
+    # while the serving engine gains an on-device drift sketch for free.
+    ref = getattr(model, "drift_ref_", None)
+    if isinstance(ref, dict) and "thresholds" in ref and "occupancy" in ref:
+        arrays["q.thresholds"] = np.asarray(ref["thresholds"], np.float32)
+        arrays["q.occupancy"] = np.asarray(ref["occupancy"], np.int32)
+        node["quality"] = {
+            "thresholds": "q.thresholds",
+            "occupancy": "q.occupancy",
+            "rows": int(ref.get("rows", 0)),
+        }
     packed = PackedModel(node, arrays)
     emit_event(
         "model_packed",

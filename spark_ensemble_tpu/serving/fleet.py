@@ -55,6 +55,7 @@ import numpy as np
 from spark_ensemble_tpu.robustness.chaos import ChaosReplicaCrash, controller
 from spark_ensemble_tpu.robustness.retry import RetryPolicy
 from spark_ensemble_tpu.serving.engine import InferenceEngine
+from spark_ensemble_tpu.telemetry.quality import staged_attribution
 from spark_ensemble_tpu.telemetry.events import (
     compile_snapshot,
     emit_event,
@@ -95,7 +96,14 @@ class FleetResponse:
 
     ``degraded`` is the explicit contract flag: ``True`` iff the value was
     computed by an ensemble-prefix tier (``tier`` = member count) rather
-    than the full model."""
+    than the full model.
+
+    The quality fields are populated only for attribution-sampled requests
+    (``attribution_fraction``; telemetry/quality.py): ``staged_margins``
+    maps each prefix-tier member count to its disagreement with the full
+    model, ``uncertainty`` is the max disagreement (per-member
+    disagreement score), and ``quality_flagged`` marks it crossing the
+    router's ``uncertainty_threshold``."""
 
     value: np.ndarray
     tier: int
@@ -104,6 +112,9 @@ class FleetResponse:
     hedged: bool
     replays: int
     latency_ms: float
+    uncertainty: Optional[float] = None
+    staged_margins: Optional[Dict[str, float]] = None
+    quality_flagged: bool = False
 
 
 class _FleetRequest:
@@ -202,6 +213,24 @@ class FleetRouter:
     breaker_backoff:
         :class:`RetryPolicy` whose deterministic ``delay(replica, n)``
         schedules the n-th ejection's half-open probe.
+    drift / drift_window:
+        Forwarded to the base :class:`InferenceEngine` when the fleet
+        builds it: on-device feature-drift sketching over the packed
+        model's fit-time bin reference (telemetry/quality.py).  All
+        replicas share one :class:`DriftMonitor`, so the window stream is
+        fleet-wide.  Ignored when ``model`` is already an engine.
+    attribution_fraction / uncertainty_threshold:
+        Staged attribution sampling: every ``1/fraction``-th full-model
+        request is decomposed over the prefix tiers (deterministic
+        ``seq``-based sampling, no RNG) and its ``FleetResponse`` carries
+        ``staged_margins`` / ``uncertainty`` / ``quality_flagged``.
+        ``0.0`` (default) keeps the serve path at exactly one program
+        dispatch per request — the tier-2 quality contract.
+    shadow:
+        Optional :class:`~spark_ensemble_tpu.telemetry.quality
+        .ShadowScorer`; sees every delivered full-tier request AFTER the
+        reply resolves (sampling happens inside the scorer).  The caller
+        owns its lifecycle (``close()``).
     """
 
     def __init__(
@@ -228,9 +257,23 @@ class FleetRouter:
         donate: Optional[bool] = None,
         label: str = "fleet",
         telemetry_path: Optional[str] = None,
+        drift: Optional[bool] = None,
+        drift_window: int = 2048,
+        attribution_fraction: float = 0.0,
+        uncertainty_threshold: float = 0.5,
+        shadow=None,
     ):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1; got {replicas}")
+        if not (0.0 <= float(attribution_fraction) <= 1.0):
+            raise ValueError(
+                "attribution_fraction must be in [0, 1]; got "
+                f"{attribution_fraction}"
+            )
+        # a router-built base engine is router-owned: stop() must stop it
+        # so its drift monitor's quality/* source dies with the fleet (an
+        # injected engine stays caller-owned, e.g. from_registry leases)
+        self._owns_base = not isinstance(model, InferenceEngine)
         if isinstance(model, InferenceEngine):
             base = model
         else:
@@ -244,6 +287,8 @@ class FleetRouter:
                 warm=True,
                 label=f"{label}:warm",
                 telemetry_path=telemetry_path,
+                drift=drift,
+                drift_window=drift_window,
             )
         self._base = base
         self._tiers = base.prefix_tiers  # ascending member counts
@@ -281,7 +326,22 @@ class FleetRouter:
         self._counters = {
             "requests": 0, "hedges_fired": 0, "hedges_won": 0,
             "shed": 0, "degraded": 0, "replays": 0, "crashes": 0,
+            "attributed": 0, "quality_flagged": 0,
         }
+        # model-quality plane (telemetry/quality.py, docs/quality.md):
+        # every 1/attribution_fraction-th full-model request is decomposed
+        # over the pre-warmed prefix tiers (staged margins + per-member
+        # disagreement as uncertainty).  Attribution is the ONE quality
+        # layer that adds dispatches (one per tier, all pre-warmed), which
+        # is why it defaults off; the drift sketch rides inside the predict
+        # programs and the shadow scorer samples after delivery.
+        self._attr_period = (
+            max(1, int(round(1.0 / float(attribution_fraction))))
+            if float(attribution_fraction) > 0.0
+            else 0
+        )
+        self._uncertainty_threshold = float(uncertainty_threshold)
+        self._shadow = shadow
         self._replicas = [
             _Replica(f"{label}:r{i}", base.clone(f"{label}:r{i}"))
             for i in range(int(replicas))
@@ -580,6 +640,28 @@ class FleetRouter:
         if slow:
             time.sleep(slow)  # alive but slow: breaker's slow streak
         serve_s = time.perf_counter() - t0
+        # staged attribution (telemetry/quality.py): sampled full-model
+        # requests are decomposed over the pre-warmed prefix tiers BEFORE
+        # delivery, so the caller's FleetResponse carries the fields
+        attribution = None
+        if (
+            self._attr_period
+            and req.tier == 0
+            and self._tiers
+            and req.seq % self._attr_period == 0
+        ):
+            attribution = staged_attribution(
+                rep.engine, req.X, method=req.method,
+                uncertainty_threshold=self._uncertainty_threshold,
+                full=out,
+            )
+            self._metrics.histogram("quality/uncertainty").record(
+                attribution["uncertainty"]
+            )
+            with self._lock:
+                self._counters["attributed"] += 1
+                if attribution["flagged"]:
+                    self._counters["quality_flagged"] += 1
         now = time.perf_counter()
         resp = FleetResponse(
             value=out,
@@ -589,8 +671,24 @@ class FleetRouter:
             hedged=req.hedged,
             replays=req.replays,
             latency_ms=(now - req.t_submit) * 1e3,
+            uncertainty=(
+                attribution["uncertainty"] if attribution else None
+            ),
+            staged_margins=(
+                attribution["margins"] if attribution else None
+            ),
+            quality_flagged=(
+                attribution["flagged"] if attribution else False
+            ),
         )
         delivered = self._resolve(req, resp)
+        if delivered and self._shadow is not None and req.tier == 0:
+            # shadow scoring rides AFTER delivery: the candidate's eval can
+            # never add latency to the answer the caller already has
+            try:
+                self._shadow.observe(req.X, out, request_id=req.seq)
+            except Exception:  # noqa: BLE001 - quality plane never breaks serving
+                pass
         serve_sp.add(delivered=delivered, serve_ms=serve_s * 1e3)
         with self._lock:
             rep.inflight -= 1
@@ -638,6 +736,16 @@ class FleetRouter:
                 hedged=resp.hedged,
                 replays=req.replays,
                 latency_ms=resp.latency_ms,
+                # attribution-sampled requests carry their uncertainty so
+                # telemetry_report can quantile it offline
+                **(
+                    {
+                        "uncertainty": resp.uncertainty,
+                        "quality_flagged": resp.quality_flagged,
+                    }
+                    if resp.uncertainty is not None
+                    else {}
+                ),
             )
             self._metrics.counter("fleet/requests").inc()
             self._metrics.histogram("fleet/latency_ms").record(
@@ -800,6 +908,8 @@ class FleetRouter:
                 rep.queue.put(_SHUTDOWN)
                 if worker is not threading.current_thread():
                     worker.join(timeout=5.0)
+        if self._owns_base:
+            self._base.stop()
         release, self._registry_release = self._registry_release, None
         if release is not None:
             release()

@@ -11,6 +11,11 @@ Live operator plane (``docs/operator.md``): ``programz`` keeps the
 per-compiled-program XLA cost inventory, ``exporter`` serves it (with the
 whole registry) over ``/metrics``/``/statusz``/``/programz``/``/healthz``,
 and ``watchdog`` applies the perf-sentinel thresholds online.
+Model-quality plane (``docs/quality.md``): ``quality`` scores on-device
+feature-drift sketches against the fit-time bin reference, decomposes
+requests over ensemble prefixes (staged attribution), and shadow-scores
+registry candidates — served at ``/qualityz`` and watched by the same
+watchdog.
 """
 
 from spark_ensemble_tpu.telemetry.flight import (
@@ -57,10 +62,19 @@ from spark_ensemble_tpu.telemetry.programz import (
     global_inventory,
     xla_cost_fields,
 )
+from spark_ensemble_tpu.telemetry.quality import (
+    DriftMonitor,
+    ShadowScorer,
+    drift_reference_from_ctx,
+    kl_divergence,
+    psi,
+    staged_attribution,
+)
 from spark_ensemble_tpu.telemetry.watchdog import (
     Rule,
     Watchdog,
     default_rules,
+    probe_quality_max,
     sentinel_thresholds,
 )
 from spark_ensemble_tpu.telemetry.trace import (
@@ -119,5 +133,12 @@ __all__ = [
     "Rule",
     "Watchdog",
     "default_rules",
+    "probe_quality_max",
     "sentinel_thresholds",
+    "DriftMonitor",
+    "ShadowScorer",
+    "drift_reference_from_ctx",
+    "kl_divergence",
+    "psi",
+    "staged_attribution",
 ]

@@ -94,6 +94,12 @@ SERVING_EVENT_TYPES = (
     # rows snapshotted into the stream (ProgramInventory.emit_rows)
     "slo_alert",
     "program",
+    # model-quality plane (docs/quality.md): per-window drift scores from
+    # the on-device sketches, sampled shadow-candidate evals, and quality
+    # alert raise/clear transitions (telemetry/quality.py)
+    "drift_window",
+    "shadow_eval",
+    "quality_alert",
 )
 
 # ---------------------------------------------------------------------------

@@ -300,10 +300,13 @@ class _Handler(BaseHTTPRequestHandler):
                 verdict = srv.health_verdict()
                 code = 200 if verdict.get("status") == "ok" else 503
                 self._send_json(verdict, code=code)
+            elif url.path == "/qualityz":
+                self._send_json(srv.qualityz())
             else:
                 self._send_json({"error": f"no such endpoint {url.path}",
                                  "endpoints": ["/metrics", "/statusz",
-                                               "/programz", "/healthz"]},
+                                               "/programz", "/healthz",
+                                               "/qualityz"]},
                                 code=404)
         except BrokenPipeError:  # scraper went away mid-reply
             pass
@@ -386,6 +389,36 @@ class OperatorServer(ThreadingHTTPServer):
         if self.watchdog is None:
             return {"status": "ok", "watchdog": "not attached"}
         return self.watchdog.verdict()
+
+    def qualityz(self) -> Dict[str, Any]:
+        """The model-quality page (docs/quality.md): every live
+        ``quality/*`` source (drift monitors, shadow scorers) plus the
+        plane's gauges/counters/histograms, and the watchdog's two
+        quality rules when one is attached."""
+        snapshot = self.registry.snapshot()
+        sources: Dict[str, Any] = {}
+        series: Dict[str, Any] = {}
+        for name, snap in sorted(snapshot.items()):
+            if not name.startswith("quality/"):
+                continue
+            if snap.get("type") == "source":
+                sources[name[len("quality/"):]] = snap.get("value")
+            else:
+                series[name] = snap.get(
+                    "value", snap.get("stats", snap)
+                )
+        rules = {}
+        if self.watchdog is not None:
+            rules = {
+                name: r
+                for name, r in self.watchdog.verdict()["rules"].items()
+                if name in ("quality_psi_max", "shadow_divergence")
+            }
+        return {
+            "streams": sources,
+            "series": series,
+            "watchdog": rules,
+        }
 
 
 class OperatorPlane:
@@ -477,6 +510,7 @@ def write_snapshot(out_dir: str, registry=None, inventory=None,
         "metrics": os.path.join(out_dir, "metrics.txt"),
         "statusz": os.path.join(out_dir, "statusz.json"),
         "programz": os.path.join(out_dir, "programz.json"),
+        "qualityz": os.path.join(out_dir, "qualityz.json"),
     }
     with open(paths["metrics"], "w") as f:
         f.write(text)
@@ -487,6 +521,9 @@ def write_snapshot(out_dir: str, registry=None, inventory=None,
         json.dump({"programs": inventory.rows(),
                    "summary": inventory.summary()},
                   f, indent=2, sort_keys=True, default=str)
+        f.write("\n")
+    with open(paths["qualityz"], "w") as f:
+        json.dump(srv.qualityz(), f, indent=2, sort_keys=True, default=str)
         f.write("\n")
     return paths
 

@@ -36,7 +36,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 __all__ = ["Rule", "Watchdog", "default_rules", "sentinel_thresholds",
-           "probe_fleet_max", "probe_gauge"]
+           "probe_fleet_max", "probe_gauge", "probe_quality_max"]
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -51,6 +51,12 @@ FALLBACK_THRESHOLDS: Dict[str, tuple] = {
     "compiles_since_warmup": ("lower", 0.0),
     "host_blocked_share":    ("lower", 0.75),
     "cost_model_error_pct":  ("lower", 200.0),
+    # model-quality plane (telemetry/quality.py, docs/quality.md): the
+    # conventional PSI major-shift mark, and the shadow scorer's rolling
+    # prediction-divergence ceiling (same number by design — both read
+    # "a quarter of the signal moved")
+    "quality_psi_max":       ("lower", 0.25),
+    "shadow_divergence":     ("lower", 0.25),
 }
 
 
@@ -103,10 +109,12 @@ def sentinel_thresholds(
 # ---------------------------------------------------------------------------
 
 
-def _fleet_values(snapshot: Dict[str, Any], key: str) -> List[float]:
+def _source_values(
+    snapshot: Dict[str, Any], prefix: str, key: str
+) -> List[float]:
     vals: List[float] = []
     for name, snap in snapshot.items():
-        if not name.startswith("fleet/") or snap.get("type") != "source":
+        if not name.startswith(prefix) or snap.get("type") != "source":
             continue
         value = snap.get("value")
         if isinstance(value, dict) and isinstance(
@@ -116,9 +124,26 @@ def _fleet_values(snapshot: Dict[str, Any], key: str) -> List[float]:
     return vals
 
 
+def _fleet_values(snapshot: Dict[str, Any], key: str) -> List[float]:
+    return _source_values(snapshot, "fleet/", key)
+
+
 def probe_fleet_max(key: str) -> Callable[[Dict[str, Any]], Optional[float]]:
     def probe(snapshot: Dict[str, Any]) -> Optional[float]:
         vals = _fleet_values(snapshot, key)
+        return max(vals) if vals else None
+    return probe
+
+
+def probe_quality_max(
+    key: str,
+) -> Callable[[Dict[str, Any]], Optional[float]]:
+    """Max of ``key`` across the live ``quality/*`` sources (drift
+    monitors publish ``psi_max``, shadow scorers ``divergence``) — one
+    drifting stream degrades the process.  ``None`` (frozen rule) while
+    no quality source is live or none has completed a window yet."""
+    def probe(snapshot: Dict[str, Any]) -> Optional[float]:
+        vals = _source_values(snapshot, "quality/", key)
         return max(vals) if vals else None
     return probe
 
@@ -166,7 +191,8 @@ def default_rules(
     """The standard rule table (docs/operator.md): serving p99 + hedge
     rate + steady-state compiles from the live ``fleet/*`` sources
     (max across routers — one sick stream degrades the process), the
-    fit ledger's host-blocked share, and the absolute cost-model error."""
+    fit ledger's host-blocked share, the absolute cost-model error, and
+    the model-quality plane's per-feature PSI + shadow divergence."""
     th = thresholds or sentinel_thresholds()
     probes: Dict[str, Callable] = {
         "serving_p99_ms": probe_fleet_max("p99_ms"),
@@ -175,6 +201,10 @@ def default_rules(
         "host_blocked_share": probe_gauge("fit/host_blocked_share"),
         "cost_model_error_pct": probe_gauge(
             "fit/cost_model_error_pct", absolute=True),
+        # sustained feature drift or candidate divergence is a health
+        # incident: same hysteresis as the systems rules (docs/quality.md)
+        "quality_psi_max": probe_quality_max("psi_max"),
+        "shadow_divergence": probe_quality_max("divergence"),
     }
     rules = []
     for name, probe in probes.items():

@@ -1,0 +1,523 @@
+"""Model-quality plane tests (docs/quality.md): the sketch math
+(PSI/KL vs hand-computed references, coarsening, divergence), the
+fit-time drift reference riding pack()/save()/take(), the engine's fused
+bin sketch (exact-count determinism across bucket sizes and request
+batching order), the DriftMonitor window state machine (padding
+correction, raise/clear ``quality_alert`` events), staged attribution,
+registry-leased shadow scoring, and the acceptance arc: a
+covariate-shifted burst through a warmed FleetRouter flips /healthz
+degraded via the ``quality_psi_max`` watchdog rule with zero
+steady-state compiles, and clears when traffic normalizes."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import spark_ensemble_tpu as se
+from spark_ensemble_tpu.ops.binning import Bins, bin_occupancy
+from spark_ensemble_tpu.serving import (
+    FleetRouter,
+    InferenceEngine,
+    ModelRegistry,
+    load_packed,
+    pack,
+)
+from spark_ensemble_tpu.telemetry.events import compile_snapshot
+from spark_ensemble_tpu.telemetry.exporter import OperatorPlane
+from spark_ensemble_tpu.telemetry.quality import (
+    DriftMonitor,
+    ShadowScorer,
+    coarsen_counts,
+    histogram_distribution,
+    kl_divergence,
+    prediction_divergence,
+    psi,
+    staged_attribution,
+)
+from spark_ensemble_tpu.telemetry.watchdog import (
+    FALLBACK_THRESHOLDS,
+    Rule,
+    Watchdog,
+    probe_quality_max,
+)
+
+
+def _data(n=256, d=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X @ rng.randn(d) + 0.1 * rng.randn(n)).astype(np.float32)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    X, y = _data()
+    model = se.GBMRegressor(
+        base_learner=se.DecisionTreeRegressor(max_depth=3),
+        num_base_learners=4,
+        seed=0,
+    ).fit(X, y)
+    return X, y, model
+
+
+@pytest.fixture(scope="module")
+def packed(fitted):
+    _, _, model = fitted
+    return pack(model)
+
+
+def _fetch(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# sketch math
+# ---------------------------------------------------------------------------
+
+
+def test_psi_matches_hand_computed():
+    ref = np.array([10, 20, 30, 40])
+    obs = np.array([40, 30, 20, 10])
+    # q = [.1 .2 .3 .4], p = [.4 .3 .2 .1]:
+    # PSI = .3 ln4 + .1 ln1.5 - .1 ln(2/3) - .3 ln(1/4)
+    want = (
+        0.3 * np.log(4.0)
+        + 0.1 * np.log(1.5)
+        - 0.1 * np.log(2.0 / 3.0)
+        - 0.3 * np.log(0.25)
+    )
+    assert np.isclose(float(psi(ref, obs, smoothing=0.0)), want, atol=1e-6)
+    assert np.isclose(float(psi(ref, ref)), 0.0, atol=1e-6)
+    # per-feature form: [d, B] in -> [d] out, rows independent
+    stacked = psi(np.stack([ref, ref]), np.stack([obs, ref]),
+                  smoothing=0.0)
+    assert stacked.shape == (2,)
+    assert np.isclose(stacked[0], want, atol=1e-6)
+    assert np.isclose(stacked[1], 0.0, atol=1e-6)
+
+
+def test_kl_matches_hand_computed():
+    ref = np.array([10, 20, 30, 40])
+    obs = np.array([40, 30, 20, 10])
+    # KL(p || q) = .4 ln4 + .3 ln1.5 + .2 ln(2/3) + .1 ln(1/4)
+    want = (
+        0.4 * np.log(4.0)
+        + 0.3 * np.log(1.5)
+        + 0.2 * np.log(2.0 / 3.0)
+        + 0.1 * np.log(0.25)
+    )
+    assert np.isclose(
+        float(kl_divergence(ref, obs, smoothing=0.0)), want, atol=1e-6
+    )
+    # smoothing keeps empty observed bins finite
+    assert np.isfinite(float(kl_divergence([5, 5, 5], [15, 0, 0])))
+
+
+def test_histogram_distribution_and_coarsening():
+    p = histogram_distribution(np.array([[1, 2, 3], [0, 0, 0]]))
+    assert p.shape == (2, 3)
+    assert np.allclose(p.sum(axis=-1), 1.0)
+    assert np.allclose(p[1], 1.0 / 3.0)  # all-empty -> uniform
+    c = coarsen_counts(np.arange(1, 9), 4)
+    assert c.tolist() == [3, 7, 11, 15]
+    assert coarsen_counts(np.arange(4), 99).tolist() == [0, 1, 2, 3]
+    # coarsening commutes with accumulation: sum-then-coarsen ==
+    # coarsen-then-sum (the monitor accumulates full-res, scores coarse)
+    a, b = np.arange(8), np.arange(8)[::-1]
+    assert np.array_equal(
+        coarsen_counts(a + b, 4), coarsen_counts(a, 4) + coarsen_counts(b, 4)
+    )
+
+
+def test_prediction_divergence_both_modes():
+    assert prediction_divergence(
+        np.array([0, 1, 1, 0]), np.array([0, 1, 0, 0]), True
+    ) == 0.25
+    assert np.isclose(
+        prediction_divergence(
+            np.array([1.0, 1.0]), np.array([2.0, 2.0]), False
+        ),
+        1.0,
+    )
+    assert prediction_divergence(np.zeros(4), np.zeros(4), False) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# fit-time reference through pack / save / take
+# ---------------------------------------------------------------------------
+
+
+def test_fit_captures_drift_reference(fitted):
+    X, _, model = fitted
+    ref = model.drift_ref_
+    assert ref["rows"] == X.shape[0]
+    d = X.shape[1]
+    assert ref["thresholds"].shape[0] == d
+    assert ref["occupancy"].shape == (d, ref["thresholds"].shape[1] + 1)
+    # occupancy is an exact row count per feature, not a sample
+    assert np.all(ref["occupancy"].sum(axis=1) == X.shape[0])
+
+
+def test_packed_quality_roundtrip_and_take(fitted, packed, tmp_path):
+    X, _, _ = fitted
+    q = packed.quality
+    assert q is not None and q["rows"] == X.shape[0]
+    packed.save(str(tmp_path / "m"))
+    loaded = load_packed(str(tmp_path / "m"))
+    q2 = loaded.quality
+    assert np.array_equal(q["thresholds"], q2["thresholds"])
+    assert np.array_equal(q["occupancy"], q2["occupancy"])
+    # the reference rides OUTSIDE the model node: bit-identical predictions
+    want = np.asarray(packed.predict(X[:32]))
+    assert np.array_equal(want, np.asarray(loaded.predict(X[:32])))
+    # prefix slices keep the full-fit reference (tiers score drift too)
+    prefix = packed.take(2)
+    assert np.array_equal(prefix.quality["occupancy"], q["occupancy"])
+
+
+# ---------------------------------------------------------------------------
+# fused sketch: exact counts, invariant to buckets and batching order
+# ---------------------------------------------------------------------------
+
+
+def test_bin_occupancy_exact_and_split_invariant(fitted, packed):
+    X, _, _ = fitted
+    bins = Bins(thresholds=packed.quality["thresholds"])
+    whole = np.asarray(bin_occupancy(X, bins))
+    assert whole.dtype == np.int32
+    assert np.all(whole.sum(axis=1) == X.shape[0])
+    pieces = np.zeros_like(whole)
+    for lo, hi in ((0, 1), (1, 8), (8, 17), (17, 256)):
+        pieces += np.asarray(bin_occupancy(X[lo:hi], bins))
+    assert np.array_equal(whole, pieces)
+
+
+def test_drift_scores_invariant_to_buckets_and_order(fitted, packed):
+    """The same 96 rows served through different engine bucket configs
+    and different request orders must produce IDENTICAL window scores:
+    the sketch is exact integer counts and summation commutes."""
+    X, _, _ = fitted
+    rows = X[:96]
+
+    def serve(min_bucket, max_batch, order):
+        eng = InferenceEngine(
+            packed, methods=("predict",), min_bucket=min_bucket,
+            max_batch_size=max_batch, warm=True, drift=True,
+            drift_window=96,
+        )
+        try:
+            for lo, hi in order:
+                eng.predict(rows[lo:hi])
+            snap = eng.drift_monitor.snapshot()
+            assert snap["windows"] == 1, snap
+            return eng.drift_monitor.feature_psi(), snap
+        finally:
+            eng.stop()
+
+    psi_a, snap_a = serve(8, 32, ((0, 5), (5, 40), (40, 96)))
+    psi_b, snap_b = serve(16, 64, ((40, 96), (0, 5), (5, 40)))
+    assert np.array_equal(psi_a, psi_b)
+    assert snap_a["psi_max"] == snap_b["psi_max"]
+    assert snap_a["rows_total"] == snap_b["rows_total"] == 96
+
+
+def test_engine_drift_auto_enable_and_bit_identity(fitted, packed):
+    X, _, _ = fitted
+    on = InferenceEngine(packed, methods=("predict",), min_bucket=8,
+                         max_batch_size=32, warm=True)
+    off = InferenceEngine(packed, methods=("predict",), min_bucket=8,
+                          max_batch_size=32, warm=True, drift=False)
+    try:
+        # a packed quality reference auto-enables the sketch
+        assert on.stats()["drift_enabled"] is True
+        assert off.stats()["drift_enabled"] is False
+        for n in (1, 7, 30):
+            assert np.array_equal(on.predict(X[:n]), off.predict(X[:n]))
+        assert on.stats()["drift"]["rows_total"] == 38
+        assert off.stats()["drift"] is None
+    finally:
+        on.stop()
+        off.stop()
+    # drift=True without a packed reference is a loud config error
+    X2, y2 = _data(n=64, d=3, seed=1)
+    bare = pack(se.GBMRegressor(num_base_learners=2, seed=0).fit(X2, y2))
+    if bare.quality is not None:
+        bare._node.pop("quality")
+    with pytest.raises(ValueError, match="drift"):
+        InferenceEngine(bare, warm=False, drift=True)
+
+
+# ---------------------------------------------------------------------------
+# DriftMonitor state machine
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_monitor(tmp_path=None, **kw):
+    # 1 feature, 4 bins with thresholds [-1, 0, 1]; uniform reference
+    thr = np.array([[-1.0, 0.0, 1.0]], np.float32)
+    ref = np.array([[100, 100, 100, 100]], np.int64)
+    kw.setdefault("window_rows", 40)
+    kw.setdefault("score_groups", 4)
+    path = str(tmp_path / "drift.jsonl") if tmp_path else None
+    return DriftMonitor(thr, ref, telemetry_path=path, **kw)
+
+
+def test_drift_monitor_pad_correction():
+    mon = _synthetic_monitor()
+    try:
+        # 10 real rows uniform + 30 pad rows; pads land in the zero bin
+        # (searchsorted(thr, 0.0) == 1) and must subtract back out
+        counts = np.array([[10, 10 + 30, 10, 10]])
+        mon.observe(counts, pad_rows=30)
+        mon.observe(np.array([[0, 0, 0, 0]]))
+        snap = mon.snapshot()
+        assert snap["windows"] == 1
+        assert snap["current_rows"] == 0
+        assert np.isclose(snap["psi_max"], 0.0, atol=1e-4), snap
+    finally:
+        mon.close()
+
+
+def test_drift_monitor_alert_raise_and_clear(tmp_path):
+    mon = _synthetic_monitor(tmp_path)
+    try:
+        uniform = np.array([[10, 10, 10, 10]])
+        shifted = np.array([[0, 0, 0, 40]])
+        mon.observe(uniform)          # window 1: in-distribution
+        assert mon.snapshot()["alert_active"] is False
+        mon.observe(shifted)          # window 2: mass collapsed -> alert
+        snap = mon.snapshot()
+        assert snap["alert_active"] is True
+        assert snap["psi_max"] > mon.psi_threshold
+        assert snap["drifted_features"] == 1
+        assert "f0" in snap["top"]
+        mon.observe(uniform)          # window 3: clears
+        assert mon.snapshot()["alert_active"] is False
+    finally:
+        mon.close()
+    events = [json.loads(line) for line in
+              (tmp_path / "drift.jsonl").read_text().splitlines()]
+    windows = [e for e in events if e["event"] == "drift_window"]
+    alerts = [e for e in events if e["event"] == "quality_alert"]
+    assert [w["window"] for w in windows] == [1, 2, 3]
+    assert [a["state"] for a in alerts] == ["raised", "cleared"]
+    assert alerts[0]["metric"] == "psi_max"
+    assert alerts[0]["value"] > alerts[0]["threshold"]
+
+
+def test_drift_monitor_rejects_mismatched_shapes():
+    with pytest.raises(ValueError, match="occupancy"):
+        DriftMonitor(np.zeros((2, 3), np.float32), np.zeros((2, 3)))
+    mon = _synthetic_monitor()
+    try:
+        with pytest.raises(ValueError, match="histogram"):
+            mon.observe(np.zeros((2, 4)))
+    finally:
+        mon.close()
+
+
+# ---------------------------------------------------------------------------
+# staged attribution + shadow scoring
+# ---------------------------------------------------------------------------
+
+
+def test_staged_attribution_margins_and_uncertainty(fitted, packed):
+    X, _, _ = fitted
+    eng = InferenceEngine(packed, methods=("predict",),
+                          prefix_tiers=(1, 2), min_bucket=8,
+                          max_batch_size=32, warm=True)
+    try:
+        att = staged_attribution(eng, X[:16])
+        assert att["tiers"] == [1, 2]
+        assert set(att["margins"]) == {"1", "2"}
+        assert att["uncertainty"] == max(att["margins"].values())
+        assert isinstance(att["flagged"], bool)
+        # a 1-member prefix of a 4-member GBM genuinely disagrees
+        assert att["margins"]["1"] > 0.0
+        # the caller-supplied full answer short-circuit is equivalent
+        att2 = staged_attribution(eng, X[:16], full=eng.predict(X[:16]))
+        assert att2["margins"] == att["margins"]
+    finally:
+        eng.stop()
+
+
+def test_fleet_attribution_populates_response(fitted, packed):
+    X, _, _ = fitted
+    with FleetRouter(
+        packed, replicas=1, prefix_tiers=(1, 2), min_bucket=8,
+        max_batch_size=32, deadline_ms=30_000.0, drift=False,
+        attribution_fraction=1.0, uncertainty_threshold=-1.0,
+    ) as fleet:
+        resp = fleet.predict(X[:8])
+        assert resp.uncertainty is not None
+        assert set(resp.staged_margins) == {"1", "2"}
+        assert resp.quality_flagged is True  # threshold -1 flags any
+        slo = fleet.stats()["fleet"]
+        assert slo["attributed"] >= 1
+        assert slo["quality_flagged"] >= 1
+
+
+def test_fleet_stop_closes_owned_drift_source(fitted, packed):
+    """Regression: the router-built base engine owns its drift monitor,
+    so FleetRouter.stop() must unregister the ``quality/*`` source — a
+    leaked live source with a stale ``psi_max`` would poison every later
+    watchdog's ``quality_psi_max`` probe (max over live sources)."""
+    from spark_ensemble_tpu.telemetry import global_metrics
+
+    X, _, _ = fitted
+    fleet = FleetRouter(
+        packed, replicas=2, min_bucket=8, max_batch_size=32,
+        deadline_ms=30_000.0, drift=True, drift_window=64,
+    )
+    try:
+        for i in range(4):
+            fleet.predict(X[16 * i: 16 * (i + 1)])
+        live = [k for k in global_metrics().snapshot()
+                if k.startswith("quality/") and "warm" in k]
+        assert live, "drift-enabled fleet must register its quality source"
+    finally:
+        fleet.stop()
+    leaked = [k for k in global_metrics().snapshot()
+              if k.startswith("quality/") and "warm" in k]
+    assert leaked == [], leaked
+
+
+def test_shadow_scorer_sampling_divergence_and_labels(fitted, packed):
+    X, y, _ = fitted
+    registry = ModelRegistry()
+    registry.register("candidate", packed, warm=True, min_bucket=8,
+                      max_batch_size=32)
+    scorer = ShadowScorer(registry, "candidate", fraction=0.5, window=8)
+    try:
+        primary = np.asarray(packed.predict(X[:8]))
+        for i in range(4):
+            scorer.observe(X[:8], primary, request_id=i)
+        snap = scorer.snapshot()
+        assert snap["requests_seen"] == 4
+        assert snap["evals"] == 2          # every 2nd request sampled
+        # same model both sides: divergence is float-ulp noise only (the
+        # candidate serves through bucketed programs, the primary raw)
+        assert snap["divergence"] < 1e-6
+        assert snap["errors"] == 0
+        # ids 0 and 2 were sampled; 1 was not
+        assert scorer.record_label(0, y[:8]) is True
+        assert scorer.record_label(1, y[:8]) is False
+        assert np.isclose(scorer.snapshot()["accuracy_delta"], 0.0)
+    finally:
+        scorer.close()
+        registry.close()
+
+
+def test_shadow_scorer_survives_sick_candidate(fitted, packed):
+    X, _, _ = fitted
+    registry = ModelRegistry()
+    scorer = ShadowScorer(registry, "never-registered", fraction=1.0)
+    try:
+        primary = np.asarray(packed.predict(X[:8]))
+        assert scorer.observe(X[:8], primary) is None
+        snap = scorer.snapshot()
+        assert snap["errors"] == 1 and snap["evals"] == 0
+    finally:
+        scorer.close()
+        registry.close()
+
+
+# ---------------------------------------------------------------------------
+# watchdog + /healthz acceptance arc
+# ---------------------------------------------------------------------------
+
+
+def test_quality_rules_in_default_surface():
+    assert FALLBACK_THRESHOLDS["quality_psi_max"] == ("lower", 0.25)
+    assert FALLBACK_THRESHOLDS["shadow_divergence"] == ("lower", 0.25)
+
+
+def test_probe_quality_max_scans_live_sources():
+    probe = probe_quality_max("psi_max")
+    assert probe({}) is None  # no monitor live -> rule freezes
+    mon = _synthetic_monitor(stream="probe-test")
+    try:
+        mon.observe(np.array([[0, 0, 0, 40]]))
+        from spark_ensemble_tpu.telemetry.events import global_metrics
+
+        value = probe(global_metrics().snapshot())
+        assert value is not None and value > 0.25
+    finally:
+        mon.close()
+
+
+def test_fleet_drift_arc_flips_healthz_and_clears(fitted, packed,
+                                                  tmp_path):
+    """The acceptance demo, fully deterministic: a covariate-shifted
+    burst through a warmed drift-on fleet scores a window past the PSI
+    threshold, lands ``quality_alert``, flips /healthz degraded through
+    the ``quality_psi_max`` rule, and clears (hysteresis: clear_for=2)
+    once traffic normalizes — all with ZERO steady-state compiles."""
+    X, _, _ = fitted
+    telemetry = tmp_path / "quality.jsonl"
+    dog = Watchdog(
+        rules=[Rule("quality_psi_max", probe_quality_max("psi_max"),
+                    threshold=0.25, breach_for=1, clear_for=2)],
+        interval_s=3600.0,
+        telemetry_path=str(telemetry),
+    )
+    plane = OperatorPlane(port=0, watchdog=dog,
+                          sampler_interval_s=3600.0).start()
+    try:
+        with FleetRouter(
+            packed, replicas=1, min_bucket=32, max_batch_size=64,
+            deadline_ms=30_000.0, drift=True, drift_window=256,
+            telemetry_path=str(telemetry),
+        ) as fleet:
+            before = compile_snapshot()[0]
+            for i in range(4):                   # window 1: in-dist
+                fleet.predict(X[64 * i: 64 * (i + 1)])
+            dog.evaluate_once()
+            code, _ = _fetch(plane.url + "/healthz")
+            assert code == 200
+            for i in range(4):                   # window 2: shifted
+                fleet.predict(X[64 * i: 64 * (i + 1)] + 3.0)
+            dog.evaluate_once()
+            code, body = _fetch(plane.url + "/healthz")
+            assert code == 503
+            assert "quality_psi_max" in body
+            code, body = _fetch(plane.url + "/qualityz")
+            qz = json.loads(body)
+            drift_streams = [v for v in qz["streams"].values()
+                             if v.get("kind") == "drift"]
+            assert drift_streams and drift_streams[0]["alert_active"]
+            assert drift_streams[0]["psi_max"] > 0.25
+            for i in range(4):                   # window 3: normalized
+                fleet.predict(X[64 * i: 64 * (i + 1)])
+            dog.evaluate_once()
+            code, _ = _fetch(plane.url + "/healthz")
+            assert code == 503                   # clear_for=2 holds
+            dog.evaluate_once()
+            code, _ = _fetch(plane.url + "/healthz")
+            assert code == 200
+            # the whole arc rode the warmed programs: the sketch is fused,
+            # the shifted rows hit the same buckets
+            assert compile_snapshot()[0] == before
+            # /metrics renders the quality series
+            code, body = _fetch(plane.url + "/metrics")
+            assert "se_tpu_quality_psi_max" in body
+    finally:
+        plane.stop()
+    events = [json.loads(line)
+              for line in telemetry.read_text().splitlines()]
+    windows = [e for e in events if e["event"] == "drift_window"]
+    assert [w["window"] for w in windows] == [1, 2, 3]
+    assert windows[0]["psi_max"] < 0.25 < windows[1]["psi_max"]
+    assert windows[2]["psi_max"] < 0.25
+    alerts = [e for e in events if e["event"] == "quality_alert"]
+    assert [a["state"] for a in alerts] == ["raised", "cleared"]
+    slo = [e for e in events if e["event"] == "slo_alert"]
+    assert [a["state"] for a in slo] == ["raised", "cleared"]
+    assert all(a["metric"] == "quality_psi_max" for a in slo)

@@ -80,6 +80,22 @@ def test_program_table_dedupes_reemitted_rows():
     assert "analyzed" in table and "pending" not in table
 
 
+def test_canned_quality_section_renders(capsys):
+    """Model-quality extension of the golden (docs/quality.md): the
+    stream holds ``drift_window``/``shadow_eval``/``quality_alert`` rows
+    plus attribution-sampled ``fleet_request`` rows, so the report must
+    render the quality section — byte-pinned above, shape-pinned here."""
+    assert report.main([CANNED]) == 0
+    text = capsys.readouterr().out
+    assert "== model quality ==" in text
+    assert "top psi: f2 1.314" in text
+    assert "shadow[shadow:1:0]: candidate gbm-v2" in text
+    assert "uncertainty: 2 sampled" in text
+    assert "alert raised: psi_max" in text
+    # quality-only streams summarize here, never as empty fit headers
+    assert "== shadow:1:0 ==" not in text
+
+
 def test_fit_filter_and_aggregate_jsonl(tmp_path, capsys):
     out = tmp_path / "agg.jsonl"
     assert report.main([CANNED, "--fit", "GBMRegressor",

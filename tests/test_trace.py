@@ -379,9 +379,12 @@ def test_prefetcher_reconstructs_worker_spans(tmp_path):
 def test_fleet_request_spans_and_statusz(fitted):
     X, y, model = fitted
     with record_fits() as rec:
+        # hedge seed past the deadline: this test pins the UNhedged span
+        # shape (6 reqs -> 6 serves, no flow arrows), so a slow first
+        # serve on a loaded host must not fire a real hedge
         router = FleetRouter(
             model, replicas=2, min_bucket=8, max_batch_size=16,
-            deadline_ms=30_000.0,
+            deadline_ms=30_000.0, hedge_init_ms=30_000.0,
         )
         try:
             for _ in range(6):

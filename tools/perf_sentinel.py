@@ -73,6 +73,10 @@ METRICS: Dict[str, Any] = {
     # (a cost-model drift tripwire: either model changing moves it)
     "exporter_overhead_pct":      ("lower", 0.50, 1.0),
     "xla_vs_analytic_cost_ratio": ("lower", 0.50, 0.25),
+    # model-quality plane (telemetry/quality.py): the fused drift-sketch's
+    # steady-state serve cost, drift-on vs drift-off over warm programs on
+    # the fleet leg — 2.0 abs = the <2% budget (docs/quality.md#overhead)
+    "drift_overhead_pct":         ("lower", 0.50, 2.0),
 }
 
 

@@ -32,6 +32,20 @@ configures (SE_TPU_CHAOS + serving faults):
         stall+crash window must flip /healthz to 503 (and recovery must
         flip it back), and the validated snapshot files land in DIR.
 
+A fourth subcommand drives the model-quality observability plane (same
+CI job; docs/quality.md):
+
+    python tools/serving_smoke.py quality --out DIR [--telemetry PATH]
+        Load the artifact (its fit-time drift reference included), serve
+        in-distribution traffic through a drift-enabled fleet, push a
+        deterministic covariate-shifted burst until the on-device sketch
+        window flips /healthz to 503 via the quality_psi_max watchdog
+        rule, then normalize and assert the alert clears — with
+        registry-leased shadow scoring and staged attribution riding
+        along, zero steady-state compiles, and the degraded-state
+        /qualityz + /metrics scrapes plus the filtered quality JSONL
+        landing in --artifacts.
+
 Exit code 0 = every assertion held; any mismatch raises.
 """
 
@@ -400,6 +414,199 @@ def cmd_fleet(args):
     }))
 
 
+def cmd_quality(args):
+    """The model-quality acceptance arc (CI `serving-chaos` job;
+    docs/quality.md), fully deterministic: serve in-distribution traffic
+    through a drift-enabled fleet (/healthz 200), push a covariate-
+    shifted burst (every feature +3 sigma) until a sketch window scores
+    past the PSI threshold and /healthz flips 503 via the
+    ``quality_psi_max`` watchdog rule, then normalize traffic and assert
+    the alert clears — with ZERO steady-state compiles, shadow scoring
+    leasing a prefix candidate from a live registry, and sampled staged
+    attribution riding the responses.  The quality events (drift_window
+    / shadow_eval / quality_alert) land in --telemetry and the filtered
+    quality JSONL + /qualityz + /metrics snapshots in --artifacts."""
+    from spark_ensemble_tpu.robustness.chaos import ChaosController, install
+    from spark_ensemble_tpu.serving import (
+        FleetRouter,
+        ModelRegistry,
+        load_packed,
+    )
+    from spark_ensemble_tpu.telemetry.exporter import (
+        OperatorPlane,
+        validate_openmetrics,
+    )
+    from spark_ensemble_tpu.telemetry.quality import ShadowScorer
+    from spark_ensemble_tpu.telemetry.watchdog import (
+        Rule,
+        Watchdog,
+        probe_quality_max,
+    )
+
+    expected = np.load(os.path.join(args.out, "expected.npz"))
+    X = expected["X"]
+    packed = load_packed(os.path.join(args.out, "model"))
+    assert packed.quality is not None, (
+        "exported artifact carries no drift reference; re-export with a "
+        "binned-fit model"
+    )
+    os.makedirs(args.artifacts, exist_ok=True)
+    if args.telemetry is None:
+        # the arc's JSONL assertions need the stream on disk
+        args.telemetry = os.path.join(args.artifacts, "telemetry.jsonl")
+    # only this run's rows count: a shared/reused stream may hold events
+    # from earlier arcs (the CI fleet step appends to the same file)
+    tel_offset = (
+        os.path.getsize(args.telemetry)
+        if os.path.exists(args.telemetry) else 0
+    )
+    # the env-chaos battery must not perturb the window row counts: a
+    # stalled request still serves (rows still counted), but a crashed
+    # replica replays rows into the sketch twice — pin a quiet controller
+    install(ChaosController(seed=0, rate=0.0))
+
+    window = int(args.drift_window)
+    batch = 64
+    dog = Watchdog(
+        rules=[Rule(
+            "quality_psi_max", probe_quality_max("psi_max"),
+            threshold=float(args.psi_threshold),
+            breach_for=1, clear_for=2,
+        )],
+        interval_s=3600.0,  # ticked explicitly below, deterministic
+        telemetry_path=args.telemetry,
+    )
+    plane = OperatorPlane(
+        port=0, watchdog=dog, sampler_interval_s=3600.0
+    ).start()
+    registry = ModelRegistry()
+    tier = max(1, packed.num_members // 2)
+    registry.register("candidate", packed.take(tier), warm=True,
+                      min_bucket=batch, max_batch_size=batch)
+    shadow = ShadowScorer(
+        registry, "candidate", fraction=0.25,
+        telemetry_path=args.telemetry,
+    )
+    router = FleetRouter(
+        packed,
+        # one replica: a hedged request would serve the same rows twice
+        # and double-count them into the shared drift sketch, breaking
+        # the one-window-per-phase determinism this smoke pins
+        replicas=1,
+        prefix_tiers=(tier,),
+        min_bucket=batch,
+        max_batch_size=batch,
+        deadline_ms=10_000.0,
+        drift=True,
+        drift_window=window,
+        attribution_fraction=0.25,
+        shadow=shadow,
+        telemetry_path=args.telemetry,
+        label="quality-fleet",
+    )
+    try:
+        def serve_window(shift=0.0):
+            # exactly one sketch window per call: batches never pad
+            # (rows == bucket), so window closure is deterministic
+            for i in range(window // batch):
+                lo = (i * batch) % (X.shape[0] - batch)
+                router.predict(X[lo:lo + batch] + np.float32(shift))
+
+        serve_window()            # window 1: the training rows themselves
+        dog.evaluate_once()
+        code, body = _fetch(plane.url + "/healthz")
+        assert code == 200, (code, body)
+
+        serve_window(shift=3.0)   # window 2: covariate-shifted burst
+        dog.evaluate_once()
+        code, body = _fetch(plane.url + "/healthz")
+        assert code == 503, (code, body)
+        verdict = json.loads(body)
+        assert any(a["metric"] == "quality_psi_max"
+                   for a in verdict["alerts"]), verdict
+
+        # scrape the quality surface while degraded: /qualityz must show
+        # the live drift stream in alert, /metrics must render the
+        # se_tpu_quality_* series and still validate
+        code, qbody = _fetch(plane.url + "/qualityz")
+        assert code == 200, code
+        qz = json.loads(qbody)
+        drift_streams = [v for v in qz["streams"].values()
+                         if v.get("kind") == "drift"]
+        assert drift_streams and drift_streams[0]["alert_active"], qz
+        psi_max = float(drift_streams[0]["psi_max"])
+        code, metrics_text = _fetch(plane.url + "/metrics")
+        assert code == 200, code
+        assert "se_tpu_quality_psi_max" in metrics_text
+        problems = validate_openmetrics(metrics_text)
+        assert not problems, problems[:5]
+        with open(os.path.join(args.artifacts, "qualityz_degraded.json"),
+                  "w") as f:
+            f.write(qbody)
+        with open(os.path.join(args.artifacts, "metrics_degraded.txt"),
+                  "w") as f:
+            f.write(metrics_text)
+
+        serve_window()            # window 3: traffic normalizes
+        dog.evaluate_once()
+        code, _ = _fetch(plane.url + "/healthz")
+        assert code == 503, "clear_for=2 must hold one more tick"
+        dog.evaluate_once()
+        code, body = _fetch(plane.url + "/healthz")
+        assert code == 200, (code, body)
+
+        snap = router.slo_snapshot()
+        assert snap["compiles_since_warmup"] == 0, snap
+        assert snap["attributed"] >= 1, snap
+        shadow_snap = shadow.snapshot()
+        assert shadow_snap["evals"] >= 1, shadow_snap
+        assert shadow_snap["errors"] == 0, shadow_snap
+    finally:
+        install(None)  # hand the env-configured controller back
+        router.stop()
+        shadow.close()
+        registry.close()
+        plane.stop()
+
+    # the quality JSONL artifact: just this arc's quality-plane events,
+    # filtered out of the shared telemetry stream
+    quality_events = []
+    if os.path.exists(args.telemetry):
+        with open(args.telemetry) as f:
+            f.seek(tel_offset)
+            for line in f:
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if ev.get("event") in ("drift_window", "shadow_eval",
+                                       "quality_alert", "slo_alert"):
+                    quality_events.append(ev)
+    quality_path = os.path.join(args.artifacts, "quality_events.jsonl")
+    with open(quality_path, "w") as f:
+        for ev in quality_events:
+            f.write(json.dumps(ev) + "\n")
+    windows = [e for e in quality_events
+               if e["event"] == "drift_window"]
+    alerts = [e for e in quality_events
+              if e["event"] == "quality_alert"
+              and e.get("metric") == "psi_max"]
+    assert [a["state"] for a in alerts] == ["raised", "cleared"], alerts
+    print(json.dumps({
+        "healthz_flip": ["ok", "degraded", "ok"],
+        "alert_metric": "quality_psi_max",
+        "psi_max_degraded": psi_max,
+        "psi_threshold": float(args.psi_threshold),
+        "drift_windows": len(windows),
+        "shadow_evals": shadow_snap["evals"],
+        "attributed": snap["attributed"],
+        "compiles_since_warmup": snap["compiles_since_warmup"],
+        "quality_events": quality_path,
+        "pid": os.getpid(),
+        "telemetry": args.telemetry,
+    }))
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -435,6 +642,25 @@ def main(argv=None):
         "flips deterministically",
     )
     p_fleet.set_defaults(fn=cmd_fleet)
+    p_quality = sub.add_parser("quality")
+    p_quality.add_argument("--out", required=True)
+    p_quality.add_argument("--telemetry", default=None)
+    p_quality.add_argument(
+        "--artifacts", default="/tmp/quality-smoke",
+        help="directory for the quality JSONL + degraded-state /qualityz "
+        "and /metrics snapshots (the CI artifact)",
+    )
+    p_quality.add_argument(
+        "--drift-window", type=int, default=512,
+        help="sketch window in rows; served in 64-row no-pad batches so "
+        "each phase closes exactly one window",
+    )
+    p_quality.add_argument(
+        "--psi-threshold", type=float, default=0.25,
+        help="watchdog threshold for quality_psi_max; the +3-sigma burst "
+        "scores far past any sane value, the clean windows far under",
+    )
+    p_quality.set_defaults(fn=cmd_quality)
     args = parser.parse_args(argv)
     os.makedirs(args.out, exist_ok=True)
     args.fn(args)

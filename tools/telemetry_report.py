@@ -311,6 +311,80 @@ def fleet_slo_line(fit_events: List[dict]) -> Optional[str]:
     return "  ".join(parts)
 
 
+def quality_section(events: List[dict]) -> Optional[str]:
+    """Model-quality plane summary (docs/quality.md) from the
+    ``drift_window`` / ``shadow_eval`` / ``quality_alert`` events plus
+    the attribution fields riding ``fleet_request``: per-stream drift
+    windows with the top drifting features by PSI, shadow divergence,
+    sampled-uncertainty quantiles, and every alert transition."""
+    windows = [e for e in events if e.get("event") == "drift_window"]
+    shadows = [e for e in events if e.get("event") == "shadow_eval"]
+    alerts = [e for e in events if e.get("event") == "quality_alert"]
+    unc = sorted(
+        float(e["uncertainty"])
+        for e in events
+        if e.get("event") == "fleet_request" and "uncertainty" in e
+    )
+    if not (windows or shadows or alerts or unc):
+        return None
+    lines = []
+    by_drift: Dict[str, List[dict]] = {}
+    for e in windows:
+        by_drift.setdefault(e.get("fit_id", "?"), []).append(e)
+    for stream in sorted(by_drift):
+        evs = by_drift[stream]
+        last = evs[-1]
+        rows = sum(int(e.get("rows", 0)) for e in evs)
+        worst = max(float(e.get("psi_max", 0.0)) for e in evs)
+        lines.append(
+            f"drift[{stream}]: {len(evs)} windows  {rows} rows  "
+            f"psi_max {float(last.get('psi_max', 0.0)):.3f} "
+            f"(worst {worst:.3f})  "
+            f"kl_max {float(last.get('kl_max', 0.0)):.3f}  "
+            f"drifted {int(last.get('drifted_features', 0))}"
+        )
+        top = last.get("top") or {}
+        if top:
+            ranked = "  ".join(
+                f"{k} {float(v):.3f}"
+                for k, v in sorted(top.items(), key=lambda kv: -kv[1])
+            )
+            lines.append(f"  top psi: {ranked}")
+    by_shadow: Dict[str, List[dict]] = {}
+    for e in shadows:
+        by_shadow.setdefault(e.get("fit_id", "?"), []).append(e)
+    for stream in sorted(by_shadow):
+        evs = by_shadow[stream]
+        last = evs[-1]
+        srows = sum(int(e.get("rows", 0)) for e in evs)
+        lines.append(
+            f"shadow[{stream}]: candidate {last.get('candidate', '?')}  "
+            f"{len(evs)} evals  {srows} rows  "
+            f"divergence {float(last.get('rolling_divergence', 0.0)):.3f}"
+        )
+    if unc:
+        def q(p: float) -> float:
+            return unc[min(len(unc) - 1, int(p * len(unc)))]
+
+        flagged = sum(
+            1
+            for e in events
+            if e.get("event") == "fleet_request"
+            and e.get("quality_flagged")
+        )
+        lines.append(
+            f"uncertainty: {len(unc)} sampled  p50 {q(0.5):.3f}  "
+            f"p90 {q(0.9):.3f}  max {unc[-1]:.3f}  flagged {flagged}"
+        )
+    for a in alerts:
+        lines.append(
+            f"alert {a.get('state', '?')}: {a.get('metric', '?')} "
+            f"{float(a.get('value', 0.0)):.3f} vs "
+            f"{float(a.get('threshold', 0.0)):.3f} [{a.get('fit_id', '?')}]"
+        )
+    return "\n".join(lines)
+
+
 def render_fit(fit_id: str, fit_events: List[dict]) -> str:
     lines = [f"== {fit_id} =="]
     start = next(
@@ -461,13 +535,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not fits:
             print(f"no fit_id matching {args.fit!r}")
             return 1
+    quality_only = {"drift_window", "shadow_eval", "quality_alert"}
     for fit_id in sorted(fits):
+        if all(e.get("event") in quality_only for e in fits[fit_id]):
+            continue  # summarized in == model quality == below
         print(render_fit(fit_id, fits[fit_id]))
         print()
     programs = program_table(events)
     if programs:
         print("== programz ==")
         print(programs)
+        print()
+    quality = quality_section(
+        [ev for evs in fits.values() for ev in evs]
+    )  # respects --fit: quality streams filter like any other fit_id
+    if quality:
+        print("== model quality ==")
+        print(quality)
         print()
     if streams is not None:
         skew = podview.skew_report(streams)
