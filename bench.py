@@ -626,6 +626,74 @@ def _bench_fleet(model, X, y, num_rounds):
         clean = _closed_loop()
         faulted = _closed_loop(kill_at=(n_req // 2 // n_threads) * n_threads)
 
+        # hot-swap leg (docs/autopilot.md): the same closed loop while a
+        # rolling registry swap AND one add/remove elastic cycle run
+        # mid-stream.  Evidence: dropped_requests is exactly 0 (the
+        # torn-free rebind holds queued requests and replays them on the
+        # new engine), swap_p99_ratio stays within small multiples of the
+        # clean leg, and scale_up_warm_ms prices the zero-compile clone
+        # warm-in — all three floored by tools/perf_sentinel.py
+        from spark_ensemble_tpu.serving import ModelRegistry
+
+        def _swap_loop():
+            failed = [0]
+            ops = {}
+            registry = ModelRegistry(
+                capacity=4, min_bucket=32, max_batch_size=256,
+            )
+            registry.register("prod", base.packed, warm=True)
+            # "next" is a refreshed generation stand-in: the prefix slice
+            # reuses the fit, and its registry engine is pre-warmed so the
+            # rolling swap itself compiles NOTHING
+            registry.register("next", base.packed.take(tier), warm=True)
+            fleet = FleetRouter.from_registry(
+                registry, "prod", replicas=2, deadline_ms=10_000.0,
+                label="bench-swap",
+            )
+            swap_at = (n_req // 2 // n_threads) * n_threads
+
+            def worker(tid):
+                for i in range(tid, n_req, n_threads):
+                    if tid == 0 and i == swap_at:
+                        ops["swap"] = fleet.swap_model("next")
+                        t0 = time.perf_counter()
+                        added = fleet.add_replica()
+                        ops["scale_up_warm_ms"] = round(
+                            (time.perf_counter() - t0) * 1e3, 3
+                        )
+                        fleet.remove_replica(added)
+                    try:
+                        fleet.predict(reqs[i], deadline_ms=10_000.0)
+                    except Exception:  # noqa: BLE001 - counted, not fatal
+                        failed[0] += 1
+
+            threads = [
+                _th.Thread(target=worker, args=(t,))
+                for t in range(n_threads)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            wall = time.perf_counter() - t0
+            snap = fleet.slo_snapshot()
+            fleet.stop()
+            registry.close()
+            return {
+                "qps": round(n_req / wall, 1),
+                "p50_ms": round(snap["p50_ms"], 3),
+                "p99_ms": round(snap["p99_ms"], 3),
+                "failed": failed[0],
+                "swap_ms": round(ops["swap"]["swap_ms"], 3),
+                "swap_compiles": ops["swap"]["swap_compiles"],
+                "scale_up_warm_ms": ops["scale_up_warm_ms"],
+                "version": snap["version"],
+                "compiles_after_warmup": snap["compiles_since_warmup"],
+            }
+
+        swap = _swap_loop()
+
         # skewed two-model open-loop: 90% of paced submits hit the hot
         # fleet, 10% a small cold model — the multi-model routing picture
         small = GBMClassifier(
@@ -708,6 +776,12 @@ def _bench_fleet(model, X, y, num_rounds):
             "p99_fault_ratio": round(
                 faulted["p99_ms"] / max(clean["p99_ms"], 1e-9), 3
             ),
+            "swap": swap,
+            "swap_p99_ratio": round(
+                swap["p99_ms"] / max(clean["p99_ms"], 1e-9), 3
+            ),
+            "scale_up_warm_ms": swap["scale_up_warm_ms"],
+            "dropped_requests": swap["failed"],
             "open_loop": open_loop,
             "drift_overhead_pct": (
                 round(drift_overhead_pct, 2)
@@ -1208,6 +1282,10 @@ def inner():
         fleet_stats.get("drift_overhead_pct"), (int, float)
     ):
         out["drift_overhead_pct"] = fleet_stats["drift_overhead_pct"]
+    if isinstance(fleet_stats, dict):
+        for k in ("swap_p99_ratio", "scale_up_warm_ms", "dropped_requests"):
+            if isinstance(fleet_stats.get(k), (int, float)):
+                out[k] = fleet_stats[k]
     if platform != "cpu":
         # only meaningful against a real accelerator peak; a CPU "MFU"
         # against an invented 1 TFLOP/s nominal is noise, not evidence

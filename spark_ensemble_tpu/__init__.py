@@ -131,12 +131,14 @@ from spark_ensemble_tpu.robustness import (
 )
 from spark_ensemble_tpu import serving
 from spark_ensemble_tpu.serving import (
+    Autopilot,
     FleetOverloadError,
     FleetResponse,
     FleetRouter,
     InferenceEngine,
     ModelRegistry,
     PackedModel,
+    fit_resume,
     load_packed,
     pack,
 )
@@ -269,12 +271,14 @@ __all__ = [
     "validate_fit_inputs",
     "PackedModel",
     "pack",
+    "fit_resume",
     "load_packed",
     "InferenceEngine",
     "ModelRegistry",
     "FleetRouter",
     "FleetResponse",
     "FleetOverloadError",
+    "Autopilot",
     "TUNABLES",
     "TuningCache",
     "autotune_fit",

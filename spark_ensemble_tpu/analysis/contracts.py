@@ -549,6 +549,73 @@ def _trace_fleet(report: ContractReport) -> None:
     finally:
         router.stop()
 
+    # rolling hot swap (docs/autopilot.md): a registry-backed swap under a
+    # warmed fleet pin-leases the new version's engine and shares its warm
+    # programs into every replica clone, so the swap itself compiles
+    # NOTHING (``fleet.swap_compiles``) — pinned both by the swap's own
+    # counter and the process-wide compile snapshot
+    from spark_ensemble_tpu.serving import ModelRegistry, pack
+
+    registry = ModelRegistry(
+        capacity=4, methods=("predict",), min_bucket=8, max_batch_size=32,
+    )
+    registry.register("prod", model, warm=True)
+    registry.register("next", pack(model).take(2), warm=True)
+    fleet = FleetRouter.from_registry(registry, "prod", replicas=2)
+    try:
+        fleet.predict(X[:5])
+        before = compile_snapshot()[0]
+        info = fleet.swap_model("next")
+        fleet.predict(X[:5])
+        after = compile_snapshot()[0]
+        got = max(int(info["swap_compiles"]), after - before)
+        report.budgets["fleet.swap_compiles"] = got
+        if got != 0:
+            report.violations.append(
+                ContractViolation(
+                    "serving",
+                    "fleet.swap_compiles",
+                    f"rolling hot swap performed {got} backend compile(s); "
+                    "both versions are registry-warmed, so a swap must "
+                    "rebind replicas without compiling",
+                )
+            )
+    finally:
+        fleet.stop()
+        registry.close()
+
+
+def _trace_autopilot(report: ContractReport) -> None:
+    """Trace the autopilot control loop's code budget (docs/autopilot.md).
+
+    The autopilot thread sits between the watchdog's verdicts and the
+    fleet's control plane; like the operator threads it must contain no
+    unfenced blocking reads (``autopilot.lint``) — a control loop that
+    blocks on device values can stall the very fleet it is healing.
+    Linted with absolute paths so the blanket fence-module exemptions the
+    repo-wide lint applies cannot mask a regression here."""
+    from spark_ensemble_tpu.analysis.lint import lint_file
+    from spark_ensemble_tpu.serving import autopilot
+
+    findings = [
+        f
+        for f in lint_file(
+            os.path.abspath(autopilot.__file__),
+            select=["unfenced-blocking-read"],
+        )
+        if not f.suppressed
+    ]
+    report.budgets["autopilot.lint"] = len(findings)
+    for f in findings:
+        report.violations.append(
+            ContractViolation(
+                "autopilot",
+                "autopilot.lint",
+                f"unfenced blocking read in the autopilot loop: "
+                f"{f.path}:{f.line}: {f.message}",
+            )
+        )
+
 
 def _trace_streaming(report: ContractReport) -> None:
     """Trace the out-of-core streaming fit entry points (data/streaming.py).
@@ -973,6 +1040,8 @@ def trace_contracts(
             _trace_tracing(report)
         if wanted is None or "operator" in wanted:
             _trace_operator(report)
+        if wanted is None or "autopilot" in wanted:
+            _trace_autopilot(report)
         if wanted is None or "quality" in wanted:
             _trace_quality(report)
     return report

@@ -685,6 +685,33 @@ class CheckpointableParams(Params):
             telem=telem,
         )
 
+    # -- warm-start resume (serving/export.py fit_resume) ------------------
+    #
+    # A served PackedModel is a committed-round checkpoint in disguise: the
+    # first k rounds of a stagewise fit ARE the state a checkpoint at round
+    # k-1 would hold (PackedModel.take's absolute-round-index contract).
+    # fit_resume synthesizes that state host-side and installs it here; the
+    # next fit() consumes it exactly like a loaded checkpoint and re-enters
+    # the round loop at round k.  A real on-disk checkpoint always wins —
+    # a crashed refresh fit with checkpointing retries from its own later
+    # state, never from the older packed prefix.
+
+    def _set_warm_resume(self, last_round, st):
+        self._warm_resume_state = (int(last_round), dict(st))
+        # marks this estimator as a background refresh fit: the round loop
+        # exposes chaos ``refresh_crash`` sites only on refresh fits, so a
+        # foreground fit can never trip a refresh-targeted fault
+        self._refresh_active = True
+
+    def _take_warm_resume(self):
+        state = getattr(self, "_warm_resume_state", None)
+        self._warm_resume_state = None
+        return state
+
+    @property
+    def _is_refresh_fit(self):
+        return bool(getattr(self, "_refresh_active", False))
+
 
 class Estimator(Params):
     """Base estimator: ``fit(X, y, sample_weight) -> Model``."""

@@ -69,6 +69,7 @@ from spark_ensemble_tpu.models.base import (
     resolved_scan_chunk,
 )
 from spark_ensemble_tpu.models.gbm import (
+    _check_resume_args,
     concat_pytrees,
     slice_pytree,
 )
@@ -317,6 +318,10 @@ class _BoostingParams(CheckpointableParams, Estimator):
                 )
             if not stop:
                 ctl.preempt(f"{label}:after_round:{i}")
+                if self._is_refresh_fit:
+                    # refresh-only kill site: a background warm-start fit
+                    # dies mid-round, the serving model must stay untouched
+                    ctl.refresh_crash(f"{label}:refresh_round:{i}")
             return i, bw, stop, rewound
 
         # -- the family adapter behind the shared RoundExecutor: chunk j+1
@@ -549,6 +554,12 @@ class BoostingClassifier(_BoostingParams):
         # must start fresh rather than load a wrong-length weight vector
         ckpt = self._checkpointer(n, d, num_classes, n_pad, telem=telem)
         resumed = ckpt.load_latest()
+        warm = False
+        if resumed is None:
+            # warm-start resume from a served PackedModel prefix (fit_resume
+            # in serving/export.py); a real checkpoint always wins
+            resumed = self._take_warm_resume()
+            warm = resumed is not None
         if resumed is not None:
             last_round, st = resumed
             i = last_round + 1
@@ -565,7 +576,7 @@ class BoostingClassifier(_BoostingParams):
             telem.emit(
                 "resume_from_checkpoint",
                 round=i,
-                source=detail.get("source", "latest"),
+                source="warm_start" if warm else detail.get("source", "latest"),
                 fallback=bool(detail.get("fallback", False)),
             )
 
@@ -594,6 +605,110 @@ class BoostingClassifier(_BoostingParams):
         )
         telem.finish(model=model, members=num_members)
         return model
+
+
+def _boosting_cls_bw_replay_program(base, algorithm, k):
+    """One jitted scan replaying the SAMME boosting-weight recursion over a
+    stored member stack — the warm-start half of ``fit_resume``.  Each step
+    reproduces the committed round's update exactly (same expressions, same
+    reduction order as ``round_discrete``/``round_real`` on a single
+    device), and fit-time predictions reuse leaf routing the predict path
+    reproduces bit-for-bit (models/tree.py), so the final carry equals the
+    ``bw`` a checkpoint would have stored after the last committed round.
+
+    Also returns the LAST round's weighted error: ``err <= 0`` is the one
+    stopping rule that keeps its member (perfect fit, replay() in fit), so
+    a resumed fit must treat it as terminal convergence rather than grow
+    past the point the straight fit stopped at."""
+
+    def build():
+        # not `replay`: the host replay helpers in fit share that name, and
+        # the traced-branch lint resolves jit targets by name
+        def bw_replay(members, bw, X, y):
+            if algorithm == "real":
+
+                def body(bw, m):
+                    w_norm = bw / jnp.maximum(jnp.sum(bw), 1e-30)
+                    proba = base.predict_proba_fn(m, X)
+                    miss = (
+                        jnp.argmax(proba, axis=-1) != y.astype(jnp.int32)
+                    ).astype(jnp.float32)
+                    err = jnp.sum(w_norm * miss)
+                    codes = jnp.where(
+                        jax.nn.one_hot(y.astype(jnp.int32), k) > 0,
+                        1.0,
+                        -1.0 / (k - 1.0),
+                    )
+                    ll = jnp.sum(
+                        codes * jnp.log(jnp.maximum(proba, EPSILON)), axis=-1
+                    )
+                    return w_norm * jnp.exp(-((k - 1.0) / k) * ll), err
+
+            else:
+
+                def body(bw, m):
+                    w_norm = bw / jnp.maximum(jnp.sum(bw), 1e-30)
+                    miss = (base.predict_fn(m, X) != y).astype(jnp.float32)
+                    err = jnp.sum(w_norm * miss)
+                    beta = err / jnp.maximum((1.0 - err) * (k - 1.0), 1e-30)
+                    return (
+                        w_norm
+                        * jnp.power(1.0 / jnp.maximum(beta, 1e-300), miss),
+                        err,
+                    )
+
+            out, errs = jax.lax.scan(body, bw, members)
+            return out, errs[-1]
+
+        return jax.jit(bw_replay)
+
+    return cached_program(
+        ("boosting_cls_warm_replay", algorithm, k, base.config_key()), build
+    )
+
+
+def _boosting_reg_bw_replay_program(base, loss_name):
+    """Drucker analogue of :func:`_boosting_cls_bw_replay_program`: replay
+    the R2 weight recursion (normalized errors, shaped losses, beta
+    reweighting) over the stored members to recover the post-round ``bw``."""
+
+    def build():
+        def shape_loss(e):
+            if loss_name == "exponential":
+                return 1.0 - jnp.exp(-e)
+            if loss_name == "squared":
+                return e * e
+            return e
+
+        def bw_replay(members, bw, X, y):
+            def body(bw, m):
+                w_norm = bw / jnp.maximum(jnp.sum(bw), 1e-30)
+                errors = jnp.abs(y - base.predict_fn(m, X))
+                max_error = jnp.max(errors)
+                rel = jnp.where(
+                    max_error > 0,
+                    errors / jnp.maximum(max_error, 1e-30),
+                    errors,
+                )
+                losses = shape_loss(rel)
+                est_err = jnp.sum(w_norm * losses)
+                beta = est_err / jnp.maximum(1.0 - est_err, 1e-30)
+                new_bw = w_norm * jnp.power(
+                    jnp.maximum(beta, 1e-300), 1.0 - losses
+                )
+                return (
+                    jnp.where(beta == 0.0, jnp.zeros_like(new_bw), new_bw),
+                    None,
+                )
+
+            out, _ = jax.lax.scan(body, bw, members)
+            return out
+
+        return jax.jit(bw_replay)
+
+    return cached_program(
+        ("boosting_reg_warm_replay", loss_name, base.config_key()), build
+    )
 
 
 class BoostingClassificationModel(ClassificationModel, BoostingClassifier):
@@ -646,6 +761,45 @@ class BoostingClassificationModel(ClassificationModel, BoostingClassifier):
             num_classes=self.num_classes,
             num_members=m,
             **self.get_params(),
+        )
+
+    def fit_resume(self, X, y, n_new_rounds, sample_weight=None):
+        """Continue this fitted SAMME ensemble for ``n_new_rounds`` more
+        rounds on the SAME training data — bit-identical to a single
+        ``num_members + n_new_rounds``-round fit (the ``take(k)`` contract
+        run forward): per-round ``fold_in`` keys derive from ABSOLUTE round
+        indices, and the boosting-weight carry is replayed over the stored
+        members by the exact round recursion, so round ``k`` onward sees the
+        same inputs either way.  Scope: single-device fits (``mesh=None``)
+        on the original training matrix."""
+        k, n_new = int(self.num_members), int(n_new_rounds)
+        _check_resume_args(self, k, n_new, X)
+        X32, y32 = as_f32(X), as_f32(y)
+        base = self._base().copy()
+        members = self.params["members"]
+        bw, last_err = _boosting_cls_bw_replay_program(
+            base, self.algorithm.lower(), int(self.num_classes)
+        )(members, resolve_weights(y32, sample_weight), X32, y32)
+        if float(last_err) <= 0.0:
+            # the straight fit terminally converged at round k-1 (err <= 0
+            # keeps the member, then stops); a longer fit is this model
+            return self
+        est = BoostingClassifier(
+            **{**self.get_params(), "num_base_learners": k + n_new}
+        )
+        est._set_warm_resume(
+            k - 1,
+            {
+                "bw": bw,
+                "members_layout": self.MEMBERS_LAYOUT,
+                "members": members,
+                "est_weights": jnp.asarray(
+                    self.params["weights"], jnp.float32
+                ),
+            },
+        )
+        return est.fit(
+            X, y, sample_weight=sample_weight, num_classes=self.num_classes
         )
 
 
@@ -820,6 +974,12 @@ class BoostingRegressor(_BoostingParams):
         # n_pad in the fingerprint: see BoostingClassifier.fit
         ckpt = self._checkpointer(n, d, n_pad, telem=telem)
         resumed = ckpt.load_latest()
+        warm = False
+        if resumed is None:
+            # warm-start resume from a served PackedModel prefix (fit_resume
+            # in serving/export.py); a real checkpoint always wins
+            resumed = self._take_warm_resume()
+            warm = resumed is not None
         if resumed is not None:
             last_round, st = resumed
             i = last_round + 1
@@ -836,7 +996,7 @@ class BoostingRegressor(_BoostingParams):
             telem.emit(
                 "resume_from_checkpoint",
                 round=i,
-                source=detail.get("source", "latest"),
+                source="warm_start" if warm else detail.get("source", "latest"),
                 fallback=bool(detail.get("fallback", False)),
             )
 
@@ -921,3 +1081,32 @@ class BoostingRegressionModel(RegressionModel, BoostingRegressor):
             num_members=m,
             **self.get_params(),
         )
+
+    def fit_resume(self, X, y, n_new_rounds, sample_weight=None):
+        """Continue this fitted Drucker ensemble for ``n_new_rounds`` more
+        rounds on the SAME training data — bit-identical to a single longer
+        fit; see :meth:`BoostingClassificationModel.fit_resume` for the
+        contract and scope."""
+        k, n_new = int(self.num_members), int(n_new_rounds)
+        _check_resume_args(self, k, n_new, X)
+        X32, y32 = as_f32(X), as_f32(y)
+        base = self._base().copy()
+        members = self.params["members"]
+        bw = _boosting_reg_bw_replay_program(base, self.loss.lower())(
+            members, resolve_weights(y32, sample_weight), X32, y32
+        )
+        est = BoostingRegressor(
+            **{**self.get_params(), "num_base_learners": k + n_new}
+        )
+        est._set_warm_resume(
+            k - 1,
+            {
+                "bw": bw,
+                "members_layout": self.MEMBERS_LAYOUT,
+                "members": members,
+                "est_weights": jnp.asarray(
+                    self.params["weights"], jnp.float32
+                ),
+            },
+        )
+        return est.fit(X, y, sample_weight=sample_weight)

@@ -345,6 +345,7 @@ class _GBMParams(CheckpointableParams, Estimator):
         chunk = resolved_scan_chunk(self, n_rows)
         retry_policy = self._retry_policy()
         ctl = controller()
+        refresh_fit = self._is_refresh_fit
         guard_on = guard is not None and guard.active
         # lookahead window (docs/pipeline.md): chunks kept in flight past
         # the one being committed; 0 pins the fully synchronous pre-pipeline
@@ -594,6 +595,10 @@ class _GBMParams(CheckpointableParams, Estimator):
                 # chunk's periodic save, so kill-and-resume tests exercise
                 # a real checkpoint boundary
                 ctl.preempt(f"{label}:after_round:{self.i}")
+                if refresh_fit:
+                    # refresh-only kill site: a background warm-start fit
+                    # dies mid-round, the serving model must stay untouched
+                    ctl.refresh_crash(f"{label}:refresh_round:{self.i}")
                 return invalidate
 
             def reset_frontier(self):
@@ -1150,13 +1155,19 @@ class GBMRegressor(_GBMParams):
         # than load wrong-length prediction state
         ckpt = self._checkpointer(n, d, n_pad, nv_pad, telem=telem)
         resumed = ckpt.load_latest()
+        warm = False
+        if resumed is None:
+            # warm-start resume from a served PackedModel prefix (fit_resume
+            # in serving/export.py); a real checkpoint always wins
+            resumed = self._take_warm_resume()
+            warm = resumed is not None
         if resumed is not None:
             last_round, st = resumed
             detail = ckpt.last_load_detail or {}
             telem.emit(
                 "resume_from_checkpoint",
                 round=last_round + 1,
-                source=detail.get("source", "latest"),
+                source="warm_start" if warm else detail.get("source", "latest"),
                 fallback=bool(detail.get("fallback", False)),
             )
             i, v, best = last_round + 1, int(st["v"]), float(st["best"])
@@ -1301,6 +1312,64 @@ class GBMRegressor(_GBMParams):
         )
 
 
+def _check_resume_args(model, k: int, n_new: int, X) -> None:
+    """Shared ``fit_resume`` argument gate (GBM + Boosting families)."""
+    if k < 1:
+        raise ValueError(
+            "fit_resume needs at least one committed member to resume from"
+        )
+    if n_new < 1:
+        raise ValueError(f"n_new_rounds must be >= 1; got {n_new}")
+    d = np.shape(X)[1] if np.ndim(X) == 2 else -1
+    if d != model.num_features:
+        raise ValueError(
+            f"fit_resume requires the original training matrix "
+            f"(num_features={model.num_features}); got shape {np.shape(X)}"
+        )
+
+
+def _stagewise_replay_program(base):
+    """Jitted replay of a stagewise regression carry: scan the stored
+    (member, weight) stack, accumulating ``pred += w * predict_fn(m, X)``
+    in the exact per-round f32 order the fit used.  Bit-identity leans on
+    the tree learners' routing contract: the predict re-route selects the
+    same leaf values ``fit_and_direction`` contracted at fit time."""
+
+    def build():
+        def replay(members, weights, pred, X):
+            def body(p, xs):
+                m, w = xs
+                return p + w * base.predict_fn(m, X), None
+
+            out, _ = jax.lax.scan(body, pred, (members, weights))
+            return out
+
+        return jax.jit(replay)
+
+    return cached_program(("gbm_reg_warm_replay", base.config_key()), build)
+
+
+def _stagewise_replay_program_dims(base):
+    """Class-dim variant: members are a [rounds, dim] grid, weights
+    [rounds, dim]; each round adds ``w[None, :] * dirs`` with ``dirs`` the
+    per-dim predict re-route — the same expression the fit's validation
+    path stages (bit-identical to the train-side directions)."""
+
+    def build():
+        def replay(members, weights, pred, X):
+            def body(p, xs):
+                m, w = xs
+                dirs = jax.vmap(lambda t: base.predict_fn(t, X))(m).T
+                return p + w[None, :] * dirs, None
+
+            out, _ = jax.lax.scan(body, pred, (members, weights))
+            return out
+
+        return jax.jit(replay)
+
+    return cached_program(("gbm_cls_warm_replay", base.config_key()), build)
+
+
 class GBMRegressionModel(RegressionModel, GBMRegressor):
     """predict = init + sum_i w_i * m_i(x)  (`GBMRegressor.scala:531-539`)."""
 
@@ -1347,6 +1416,54 @@ class GBMRegressionModel(RegressionModel, GBMRegressor):
             num_members=k,
             **self.get_params(),
         )
+
+    def fit_resume(self, X, y, n_new_rounds, sample_weight=None):
+        """Continue this fitted model for ``n_new_rounds`` more rounds on
+        the SAME training data — bit-identical to a single
+        ``num_members + n_new_rounds``-round fit (:meth:`take`'s
+        absolute-round-index prefix contract run forward; round keys and
+        feature masks derive from ``fold_in(root, i)``, so a larger
+        sampling plan is prefix-stable).  The committed prediction state is
+        replayed host-free from the stored members (the tree learners'
+        predict re-route is bit-identical to the fit-time leaf values —
+        ``fit_and_direction``'s contract), then installed as a warm-resume
+        state the fresh fit consumes exactly like a loaded checkpoint.
+
+        Scope: single-device fits without a validation split (the serving
+        refresh path, docs/autopilot.md); a background refresh crash leaves
+        this model untouched and the resume retryable."""
+        k, n_new = int(self.num_members), int(n_new_rounds)
+        _check_resume_args(self, k, n_new, X)
+        X32, y32 = as_f32(X), as_f32(y)
+        base = self._base().copy()
+        members = self.params["members"]
+        weights = jnp.asarray(self.params["weights"], jnp.float32)
+        pred0 = jnp.asarray(self.init_model.predict(X32), jnp.float32)
+        pred = _stagewise_replay_program(base)(members, weights, pred0, X32)
+        if self.loss.lower() == "huber":
+            # carry seed only: the chunk body recomputes huber's delta from
+            # the carried pred before every round
+            delta = weighted_quantile(y32, self.alpha)
+        else:
+            delta = jnp.asarray(0.0, jnp.float32)
+        est = GBMRegressor(
+            **{**self.get_params(), "num_base_learners": k + n_new}
+        )
+        est._set_warm_resume(
+            k - 1,
+            {
+                "v": 0,
+                "best": 0.0,
+                "val_hist": [],
+                "pred": pred,
+                "pred_val": None,
+                "members_layout": self.MEMBERS_LAYOUT,
+                "members": members,
+                "weights": weights,
+                "delta": delta,
+            },
+        )
+        return est.fit(X, y, sample_weight=sample_weight)
 
 
 class GBMClassifier(_GBMParams):
@@ -1766,13 +1883,19 @@ class GBMClassifier(_GBMParams):
         # `pred`/`pred_val` must not be resumed under a different topology
         ckpt = self._checkpointer(n, d, num_classes, n_pad, nv_pad, telem=telem)
         resumed = ckpt.load_latest()
+        warm = False
+        if resumed is None:
+            # warm-start resume from a served PackedModel prefix (fit_resume
+            # in serving/export.py); a real checkpoint always wins
+            resumed = self._take_warm_resume()
+            warm = resumed is not None
         if resumed is not None:
             last_round, st = resumed
             detail = ckpt.last_load_detail or {}
             telem.emit(
                 "resume_from_checkpoint",
                 round=last_round + 1,
-                source=detail.get("source", "latest"),
+                source="warm_start" if warm else detail.get("source", "latest"),
                 fallback=bool(detail.get("fallback", False)),
             )
             i, v, best = last_round + 1, int(st["v"]), float(st["best"])
@@ -2006,4 +2129,52 @@ class GBMClassificationModel(ClassificationModel, GBMClassifier):
             num_members=k,
             dim=self.dim,
             **self.get_params(),
+        )
+
+    def fit_resume(self, X, y, n_new_rounds, sample_weight=None):
+        """Continue for ``n_new_rounds`` more rounds on the SAME training
+        data — the classifier analogue of
+        :meth:`GBMRegressionModel.fit_resume` (see there for the contract).
+        The raw-score carry replays from ``init_raw`` over the stored
+        [round, class-dim] member grid; the line-search warm start is
+        recovered from the last committed round's weights
+        (``weights[-1] / learning_rate`` — exact whenever the learning
+        rate is a power of two, including the default 1.0)."""
+        k, n_new = int(self.num_members), int(n_new_rounds)
+        _check_resume_args(self, k, n_new, X)
+        X32 = as_f32(X)
+        base = self._base().copy()
+        members = self.params["members"]
+        weights = jnp.asarray(self.params["weights"], jnp.float32)
+        pred0 = jnp.broadcast_to(
+            self.params["init_raw"][None, :], (X32.shape[0], self.dim)
+        ).astype(jnp.float32)
+        pred = _stagewise_replay_program_dims(base)(
+            members, weights, pred0, X32
+        )
+        if bool(self.optimized_weights):
+            # weight = lr * alpha_opt on the clean path, and the carried
+            # warm start is alpha_opt itself (finite on a committed round)
+            alpha_ws = weights[-1] / jnp.float32(self.learning_rate)
+        else:
+            alpha_ws = jnp.ones((self.dim,), jnp.float32)
+        est = GBMClassifier(
+            **{**self.get_params(), "num_base_learners": k + n_new}
+        )
+        est._set_warm_resume(
+            k - 1,
+            {
+                "v": 0,
+                "best": 0.0,
+                "val_hist": [],
+                "pred": pred,
+                "pred_val": None,
+                "members_layout": self.MEMBERS_LAYOUT,
+                "members": members,
+                "weights": weights,
+                "alpha_ws": alpha_ws,
+            },
+        )
+        return est.fit(
+            X, y, sample_weight=sample_weight, num_classes=self.num_classes
         )

@@ -13,7 +13,8 @@ Environment contract (read once, cached):
   are hashed to one).  Unset/empty → no-op controller.
 - ``SE_TPU_CHAOS_FAULTS``: comma list restricting the active fault kinds
   (subset of ``nan_grad,preempt,transient,ckpt_corrupt,replica_stall,
-  replica_crash,slow_reply,host_preempt,host_stall``; default all).
+  replica_crash,slow_reply,host_preempt,host_stall,swap_crash,scale_crash,
+  refresh_crash``; default all).
 - ``SE_TPU_CHAOS_RATE``: per-site firing probability (default 0.05).
 - ``SE_TPU_CHAOS_LOG``: JSONL path appending one record per injected fault
   (uploaded as a CI artifact next to the telemetry stream).
@@ -46,6 +47,14 @@ FAULT_KINDS = (
     # straggler the pod skew report must attribute (telemetry/podview.py)
     "host_preempt",
     "host_stall",
+    # fleet control-loop faults (docs/autopilot.md): swap_crash kills a
+    # replica mid-rebind during a rolling hot swap, scale_crash kills a
+    # just-added replica during its warm-in, refresh_crash kills a
+    # background warm-start refresh fit mid-round (the serving model must
+    # stay untouched and the refresh retryable)
+    "swap_crash",
+    "scale_crash",
+    "refresh_crash",
 )
 
 
@@ -113,6 +122,11 @@ class ChaosController:
             # likewise one host loss per run: survivors must prove one
             # clean repartition+resume, not survive a dying pod
             "host_preempt": 1,
+            # one kill per control-loop experiment: the swap/scale/refresh
+            # machinery must absorb a single mid-flight death and converge
+            "swap_crash": 1,
+            "scale_crash": 1,
+            "refresh_crash": 1,
         }
         if budgets:
             self.budgets.update(budgets)
@@ -275,6 +289,34 @@ class ChaosController:
         hedging and prefix degradation rather than ejection."""
         return float(seconds) if self._fire("slow_reply", site) else 0.0
 
+    # -- fleet control-loop hooks (swap / scale / refresh) -----------------
+
+    def swap_crash(self, site: str) -> None:
+        """Raise :class:`ChaosReplicaCrash` mid-rebind during a rolling
+        hot swap (globally budgeted; default 1).  The router must treat it
+        exactly like a replica death: eject, replay the drained queue on a
+        healthy replica, and finish the swap on the survivors — every
+        response still computed by exactly one model version."""
+        if self._fire("swap_crash", site):
+            raise ChaosReplicaCrash(f"chaos: replica crashed mid-swap at {site}")
+
+    def scale_crash(self, site: str) -> None:
+        """Raise :class:`ChaosReplicaCrash` during a scale-up warm-in
+        (globally budgeted; default 1).  A replica that dies before
+        admission must never have owned a request, so the fleet drops
+        nothing — it just ends up one replica narrower than asked."""
+        if self._fire("scale_crash", site):
+            raise ChaosReplicaCrash(f"chaos: replica crashed at warm-in {site}")
+
+    def refresh_crash(self, site: str) -> None:
+        """Raise :class:`ChaosPreemption` mid-round inside a background
+        warm-start refresh fit (globally budgeted; default 1).  Not a
+        ``RuntimeError`` so no retry layer swallows it: the refresh dies,
+        the serving model stays byte-identical, and the next refresh
+        attempt succeeds (the site fires at most once)."""
+        if self._fire("refresh_crash", site):
+            raise ChaosPreemption(f"chaos: refresh fit killed at {site}")
+
 
 class _NoopController:
     """Injection disabled: every hook is a cheap no-op/identity."""
@@ -314,6 +356,15 @@ class _NoopController:
 
     def slow_s(self, site: str, seconds: float = 0.05) -> float:
         return 0.0
+
+    def swap_crash(self, site: str) -> None:
+        pass
+
+    def scale_crash(self, site: str) -> None:
+        pass
+
+    def refresh_crash(self, site: str) -> None:
+        pass
 
 
 _NOOP = _NoopController()

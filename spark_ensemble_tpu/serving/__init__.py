@@ -31,6 +31,11 @@ All of it emits ``model_packed`` / ``engine_warmup`` / ``request_served`` /
 :mod:`spark_ensemble_tpu.telemetry`, so ``tools/telemetry_report.py``
 renders serving traces unchanged.
 
+:mod:`spark_ensemble_tpu.serving.autopilot` closes the loop
+(docs/autopilot.md): :class:`Autopilot` turns watchdog verdicts into fleet
+actions — elastic scaling, warm-start refresh fits (``fit_resume``), and
+automatic rollback — each a torn-free rolling swap over the registry.
+
 The model-quality plane rides on top (docs/quality.md): packed models
 carry their fit-time bin reference (``PackedModel.quality``), engines fuse
 a per-feature drift sketch into the cached predict programs, and the fleet
@@ -38,9 +43,11 @@ adds sampled staged attribution + shadow scoring
 (:mod:`spark_ensemble_tpu.telemetry.quality`).
 """
 
+from spark_ensemble_tpu.serving.autopilot import Autopilot
 from spark_ensemble_tpu.serving.export import (
     PACKED_FORMAT_VERSION,
     PackedModel,
+    fit_resume,
     load_packed,
     pack,
 )
@@ -56,8 +63,10 @@ from spark_ensemble_tpu.serving.registry import ModelRegistry
 
 __all__ = [
     "PACKED_FORMAT_VERSION",
+    "Autopilot",
     "PackedModel",
     "pack",
+    "fit_resume",
     "load_packed",
     "InferenceEngine",
     "ModelRegistry",

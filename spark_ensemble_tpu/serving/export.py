@@ -41,7 +41,13 @@ from spark_ensemble_tpu.utils.persist import (
     _decode,
 )
 
-__all__ = ["PACKED_FORMAT_VERSION", "PackedModel", "pack", "load_packed"]
+__all__ = [
+    "PACKED_FORMAT_VERSION",
+    "PackedModel",
+    "fit_resume",
+    "pack",
+    "load_packed",
+]
 
 PACKED_FORMAT_VERSION = 1
 _ARTIFACT_KIND = "spark_ensemble_tpu.packed"
@@ -405,6 +411,34 @@ class PackedModel:
             f"PackedModel({self.class_name}, arrays={len(self._arrays)}, "
             f"bytes={self.nbytes})"
         )
+
+
+def fit_resume(packed, X, y, n_new_rounds, sample_weight=None) -> PackedModel:
+    """Warm-start refresh fit: continue a served stagewise ensemble for
+    ``n_new_rounds`` more rounds on its ORIGINAL training data and repack.
+
+    The inverse direction of :meth:`PackedModel.take`: where ``take(k)``
+    proves a packed prefix IS the k-round fit, ``fit_resume`` runs the same
+    contract forward — the rebuilt model's committed round state (prediction
+    carry, boosting weights, line-search warm start) is rehydrated and the
+    round loop re-enters at the next ABSOLUTE round index, so the result is
+    bit-identical to a single ``num_members + n_new_rounds``-round fit
+    (pinned per family in ``tests/test_fit_resume.py``).  This is the
+    autopilot's drift response (serving/autopilot.py): a background refresh
+    that never recompiles or retrains the committed prefix.
+
+    Accepts a :class:`PackedModel` or an already-rebuilt fitted model.
+    Raises ``TypeError`` for families with no stagewise round structure
+    (bagging, stacking, single models)."""
+    model = packed.model() if isinstance(packed, PackedModel) else packed
+    if not hasattr(model, "fit_resume"):
+        raise TypeError(
+            f"{type(model).__name__} has no stagewise round structure; "
+            "fit_resume applies to GBM and boosting families only"
+        )
+    n_new = int(n_new_rounds)
+    resumed = model.fit_resume(X, y, n_new, sample_weight=sample_weight)
+    return pack(resumed)
 
 
 def pack(model) -> PackedModel:

@@ -31,6 +31,14 @@ a fault-tolerance tier above the engine:
   :meth:`kill_replica`) drains that replica's queue and replays every
   unanswered request on a healthy replica — zero lost and zero duplicated
   responses, pinned by the chaos serving battery.
+- **Torn-free hot swap + elastic width**: :meth:`swap_model` replaces the
+  served model replica-by-replica under live traffic — each replica leaves
+  rotation, drains, rebinds to a clone of the new (already warmed) engine,
+  and re-admits — so every response is computed entirely by exactly one
+  model version and a registry-leased swap adds ZERO compiles.
+  :meth:`add_replica` / :meth:`remove_replica` resize the fleet the same
+  way (clone in, drain out), and ``serving/autopilot.py`` closes the loop
+  by driving all three from watchdog verdicts.
 
 Per-replica SLO telemetry flows through the existing serving event stream
 (``fleet_request`` / ``replica_state`` / ``hedge_fired`` / ``request_shed``
@@ -73,7 +81,7 @@ __all__ = [
     "FleetRouter",
 ]
 
-REPLICA_STATES = ("healthy", "degraded", "ejected", "half_open")
+REPLICA_STATES = ("healthy", "degraded", "ejected", "half_open", "swapping")
 
 _SHUTDOWN = object()
 _KILL = object()
@@ -115,6 +123,9 @@ class FleetResponse:
     uncertainty: Optional[float] = None
     staged_margins: Optional[Dict[str, float]] = None
     quality_flagged: bool = False
+    # model generation that computed this value (bumped by swap_model);
+    # the torn-free contract: exactly ONE version per response, ever
+    version: int = 0
 
 
 class _FleetRequest:
@@ -149,12 +160,13 @@ class _Replica:
         "name", "engine", "queue", "worker", "state", "inflight",
         "fail_streak", "slow_streak", "ok_streak", "ejections",
         "reopen_at", "probing", "served", "failed", "latencies",
-        "transitions",
+        "transitions", "version",
     )
 
-    def __init__(self, name: str, engine: InferenceEngine):
+    def __init__(self, name: str, engine: InferenceEngine, version: int = 0):
         self.name = name
         self.engine = engine
+        self.version = version
         self.queue: "queue_mod.SimpleQueue" = queue_mod.SimpleQueue()
         self.worker: Optional[threading.Thread] = None
         self.state = "healthy"
@@ -319,14 +331,23 @@ class FleetRouter:
         self._source_name = f"fleet/{self._stream}"
         self._metrics.register_source(self._source_name, self.slo_snapshot)
         self._lock = threading.Lock()
+        # control-plane lock: serializes swap_model/add_replica/
+        # remove_replica against each other (the hot `_lock` is never held
+        # across a rebind's quiesce wait)
+        self._ctl_lock = threading.Lock()
         self._seq = 0
+        self._version = 0
+        self._next_replica_idx = int(replicas)
         self._stopped = False
+        self._registry = None
+        self._registry_name = None
         self._registry_release = None
         self._window: "collections.deque" = collections.deque(maxlen=256)
         self._counters = {
             "requests": 0, "hedges_fired": 0, "hedges_won": 0,
             "shed": 0, "degraded": 0, "replays": 0, "crashes": 0,
             "attributed": 0, "quality_flagged": 0,
+            "swaps": 0, "scale_ups": 0, "scale_downs": 0,
         }
         # model-quality plane (telemetry/quality.py, docs/quality.md):
         # every 1/attribution_fraction-th full-model request is decomposed
@@ -366,6 +387,8 @@ class FleetRouter:
         except BaseException:
             registry._release(name)
             raise
+        router._registry = registry
+        router._registry_name = name
         router._registry_release = lambda: registry._release(name)
         return router
 
@@ -630,12 +653,19 @@ class FleetRouter:
     ) -> None:
         ctrl = controller()
         site = f"{self._label}:{rep.name}:req{req.seq}"
+        # snapshot the bound engine + version ONCE: the whole serve — the
+        # predict AND any staged attribution — runs against one model
+        # generation even if a rolling swap rebinds the replica meanwhile
+        # (it cannot while this serve is in flight, but the single read
+        # makes the no-torn-response invariant structural, not scheduled)
+        eng = rep.engine
+        version = rep.version
         stall = ctrl.stall_s(site)
         if stall:
             time.sleep(stall)  # a stuck replica: hedge timer's territory
         ctrl.crash(site)  # may raise ChaosReplicaCrash
         t0 = time.perf_counter()
-        out = rep.engine.predict(req.X, method=req.method, tier=req.tier)
+        out = eng.predict(req.X, method=req.method, tier=req.tier)
         slow = ctrl.slow_s(site)
         if slow:
             time.sleep(slow)  # alive but slow: breaker's slow streak
@@ -651,7 +681,7 @@ class FleetRouter:
             and req.seq % self._attr_period == 0
         ):
             attribution = staged_attribution(
-                rep.engine, req.X, method=req.method,
+                eng, req.X, method=req.method,
                 uncertainty_threshold=self._uncertainty_threshold,
                 full=out,
             )
@@ -680,6 +710,7 @@ class FleetRouter:
             quality_flagged=(
                 attribution["flagged"] if attribution else False
             ),
+            version=version,
         )
         delivered = self._resolve(req, resp)
         if delivered and self._shadow is not None and req.tier == 0:
@@ -736,6 +767,7 @@ class FleetRouter:
                 hedged=resp.hedged,
                 replays=req.replays,
                 latency_ms=resp.latency_ms,
+                version=resp.version,
                 # attribution-sampled requests carry their uncertainty so
                 # telemetry_report can quantile it offline
                 **(
@@ -892,6 +924,270 @@ class FleetRouter:
             rep.queue.put(_KILL)
             return rep.name
 
+    # -- hot swap / elastic width ------------------------------------------
+
+    def _quiesce(self, rep: _Replica, timeout_s: float = 30.0) -> None:
+        """Wait for a replica already OUT of rotation (drained queue, no
+        routable state) to finish its in-flight serve, then stop its worker
+        thread.  Called under ``_ctl_lock`` only — never under ``_lock``."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if rep.inflight <= 0:
+                    break
+            time.sleep(0.001)
+        worker = rep.worker
+        rep.queue.put(_SHUTDOWN)
+        if (
+            worker is not None
+            and worker.is_alive()
+            and worker is not threading.current_thread()
+        ):
+            worker.join(timeout=5.0)
+
+    def _rebind_replica(self, rep: _Replica, new_base, version: int, ctl) -> bool:
+        """One rolling-swap step: take ``rep`` out of rotation, hold its
+        queued requests, let the in-flight serve finish on the OLD engine
+        (whole-version responses, never torn), rebind to a clone of
+        ``new_base``, then re-admit and re-dispatch the held requests onto
+        the new engine.  The held requests' futures are untouched
+        throughout, so nothing is dropped and hedge duplicates still dedupe
+        at the Future.  Returns True when chaos ``swap_crash`` fired
+        mid-rebind — the kill lands while the replica is out of rotation
+        with an empty queue, so it can strand NOTHING and recovery is
+        simply completing the rebind with a fresh clone."""
+        with self._lock:
+            self._set_state(rep, "swapping", f"rebind to v{version}")
+            held = self._drain(rep)
+        self._quiesce(rep)
+        crashed = False
+        try:
+            ctl.swap_crash(f"{self._label}:{rep.name}:swap")
+        except ChaosReplicaCrash:
+            crashed = True
+            with self._lock:
+                self._counters["crashes"] += 1
+                rep.failed += 1
+                rep.ejections += 1
+            self._metrics.counter("fleet/crashes").inc()
+        old = rep.engine
+        rep.engine = new_base.clone(rep.name)
+        rep.version = version
+        old.stop()
+        with self._lock:
+            rep.fail_streak = 0
+            rep.slow_streak = 0
+            rep.ok_streak = 0
+            rep.probing = False
+            for req in held:
+                if not req.future.done():
+                    self._dispatch(req, rep)
+            self._set_state(
+                rep,
+                "healthy",
+                "rebind recovered from crash" if crashed
+                else f"serving v{version}",
+            )
+            self._ensure_worker(rep)
+        return crashed
+
+    def _resolve_swap_target(self, model, name, version):
+        """Resolve ``swap_model``'s target to a WARMED engine + ownership:
+        a registry name acquires a pin lease on its already-warmed engine
+        (zero compiles), an injected engine stays caller-owned, and a raw
+        model/PackedModel is packed + warmed here mirroring the base
+        engine's configuration (its warmup is the swap's only compile cost
+        and moves the steady-state compile boundary)."""
+        if isinstance(model, str):
+            if self._registry is None:
+                raise ValueError(
+                    "swap_model(<name>) requires a registry-backed fleet "
+                    "(FleetRouter.from_registry)"
+                )
+            registry, reg_name = self._registry, model
+            engine = registry._acquire(reg_name)
+            return engine, False, (lambda: registry._release(reg_name)), reg_name
+        if isinstance(model, InferenceEngine):
+            return model, False, None, name or model._label
+        base = InferenceEngine(
+            model,
+            methods=self._base._methods,
+            prefix_tiers=self._tiers,
+            min_bucket=self._base._buckets[0],
+            max_batch_size=self._base._max_batch,
+            donate=self._base._donate,
+            warm=True,
+            label=f"{self._label}:v{version}:warm",
+            telemetry_path=self._telemetry_path,
+        )
+        self._warm_snapshot = compile_snapshot()
+        return base, True, None, name or f"{self._label}:v{version}"
+
+    def swap_model(self, model, *, name: Optional[str] = None) -> Dict[str, Any]:
+        """Rolling, torn-free hot swap of the served model under live
+        traffic.
+
+        ``model`` is a registry name (the fleet must come from
+        :meth:`from_registry`; the new version's engine is pin-leased and
+        its warm programs are shared into every replica via ``clone()``, so
+        the swap adds ZERO compiles), an already-warmed
+        :class:`InferenceEngine`, or a fitted model / ``PackedModel``
+        (packed + warmed here first).
+
+        Replicas rebind one at a time (:meth:`_rebind_replica`): the rest
+        of the fleet keeps serving, queued requests are held and re-served
+        on the new engine, and the in-flight request finishes on the old
+        one — every response is computed entirely by exactly ONE model
+        version, and zero requests are dropped.  The previous base engine
+        is retired (stopped if router-owned, lease released if from a
+        registry) only after the last replica rebinds, so a rollback swap
+        can re-acquire it from the registry at any point.
+
+        Returns a summary dict (``version``, ``swap_ms``,
+        ``swap_compiles``, ``swap_crashes``) and emits it as a
+        ``fleet_swap`` telemetry event."""
+        if self._stopped:
+            raise RuntimeError("fleet is stopped")
+        ctl = controller()
+        t0 = time.perf_counter()
+        c0, _ = compile_snapshot()
+        with self._ctl_lock:
+            version = self._version + 1
+            new_base, new_owns, new_release, new_name = (
+                self._resolve_swap_target(model, name, version)
+            )
+            if (
+                new_base._packed.num_features
+                != self._base._packed.num_features
+            ):
+                if new_owns:
+                    new_base.stop()
+                if new_release is not None:
+                    new_release()
+                raise ValueError(
+                    "swap target serves "
+                    f"num_features={new_base._packed.num_features}, fleet "
+                    f"serves {self._base._packed.num_features}; a swap must "
+                    "not invalidate requests already admitted"
+                )
+            crashes = 0
+            for rep in list(self._replicas):
+                crashes += int(self._rebind_replica(rep, new_base, version, ctl))
+            old_base, self._base = self._base, new_base
+            old_owns, self._owns_base = self._owns_base, new_owns
+            old_release = self._registry_release
+            self._registry_release = new_release
+            self._registry_name = new_name if new_release is not None else None
+            self._tiers = new_base.prefix_tiers
+            with self._lock:
+                self._version = version
+                self._counters["swaps"] += 1
+            if old_owns:
+                old_base.stop()
+            if old_release is not None:
+                old_release()
+            c1, _ = compile_snapshot()
+            out = {
+                "version": version,
+                "model": new_name,
+                "replicas": len(self._replicas),
+                "swap_ms": (time.perf_counter() - t0) * 1e3,
+                "swap_compiles": c1 - c0,
+                "swap_crashes": crashes,
+            }
+        emit_event(
+            "fleet_swap",
+            path=self._telemetry_path,
+            fit_id=self._stream,
+            **out,
+        )
+        self._metrics.counter("fleet/swaps").inc()
+        return out
+
+    def add_replica(self, name: Optional[str] = None) -> str:
+        """Grow the fleet by one replica: a ``clone()`` of the warm base
+        engine (shared programs — zero compiles), entered into rotation
+        only once its worker is live.  Chaos ``scale_crash`` kills the
+        warm-in BEFORE rotation entry, where it can strand nothing;
+        recovery re-clones and proceeds (faults are at-most-once per
+        site)."""
+        if self._stopped:
+            raise RuntimeError("fleet is stopped")
+        ctl = controller()
+        t0 = time.perf_counter()
+        with self._ctl_lock:
+            with self._lock:
+                if name is None:
+                    name = f"{self._label}:r{self._next_replica_idx}"
+                    self._next_replica_idx += 1
+                elif any(r.name == name for r in self._replicas):
+                    raise ValueError(f"replica {name!r} already exists")
+                version = self._version
+            engine = self._base.clone(name)
+            try:
+                ctl.scale_crash(f"{self._label}:{name}:warm_in")
+            except ChaosReplicaCrash:
+                engine.stop()
+                with self._lock:
+                    self._counters["crashes"] += 1
+                self._metrics.counter("fleet/crashes").inc()
+                engine = self._base.clone(name)
+            rep = _Replica(name, engine, version)
+            with self._lock:
+                self._replicas.append(rep)
+                self._counters["scale_ups"] += 1
+                self._ensure_worker(rep)
+                n = len(self._replicas)
+        emit_event(
+            "fleet_scale",
+            path=self._telemetry_path,
+            fit_id=self._stream,
+            direction="up",
+            replica=name,
+            replicas=n,
+            warm_ms=(time.perf_counter() - t0) * 1e3,
+        )
+        return name
+
+    def remove_replica(self, name: Optional[str] = None) -> str:
+        """Shrink the fleet by one replica (default: the last one): it
+        leaves rotation first, its queued requests replay on the
+        survivors, the in-flight serve finishes, and only then do the
+        worker and the engine clone die — zero drops by construction."""
+        if self._stopped:
+            raise RuntimeError("fleet is stopped")
+        with self._ctl_lock:
+            with self._lock:
+                if len(self._replicas) <= 1:
+                    raise ValueError("cannot remove the last replica")
+                if name is None:
+                    rep = self._replicas[-1]
+                else:
+                    match = [r for r in self._replicas if r.name == name]
+                    if not match:
+                        raise ValueError(f"no replica {name!r}")
+                    rep = match[0]
+                self._replicas.remove(rep)  # out of rotation: no new work
+                for req in self._drain(rep):
+                    self._redispatch(
+                        req,
+                        {rep.name},
+                        FleetOverloadError(f"replica {rep.name} removed"),
+                    )
+                self._counters["scale_downs"] += 1
+                n = len(self._replicas)
+            self._quiesce(rep)
+            rep.engine.stop()
+        emit_event(
+            "fleet_scale",
+            path=self._telemetry_path,
+            fit_id=self._stream,
+            direction="down",
+            replica=rep.name,
+            replicas=n,
+        )
+        return rep.name
+
     # -- lifecycle / introspection ----------------------------------------
 
     def stop(self) -> None:
@@ -930,6 +1226,7 @@ class FleetRouter:
             per_replica = {
                 rep.name: {
                     "state": rep.state,
+                    "version": rep.version,
                     "served": rep.served,
                     "failed": rep.failed,
                     "queue_depth": rep.inflight,
@@ -942,6 +1239,7 @@ class FleetRouter:
             }
             out = {
                 "label": self._label,
+                "version": self._version,
                 "replicas": per_replica,
                 "requests": requests,
                 "served": served,
@@ -975,6 +1273,7 @@ class FleetRouter:
         requests = snap["requests"]
         return {
             "label": self._label,
+            "version": snap["version"],
             "stream": self._stream,
             "trace_id": self._tracer.trace_id,
             "uptime_s": time.time() - self._t_start,

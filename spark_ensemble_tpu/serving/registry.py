@@ -36,7 +36,7 @@ __all__ = ["ModelRegistry"]
 class _Entry:
     __slots__ = (
         "packed", "engine", "opts", "hits", "activations", "last_used",
-        "pins", "pending_offload",
+        "pins", "pending_offload", "pending_remove",
     )
 
     def __init__(self, packed: PackedModel, opts: Dict[str, Any]):
@@ -51,6 +51,7 @@ class _Entry:
         # hot-swap can never free arrays out from under an unsent reply
         self.pins = 0
         self.pending_offload = False
+        self.pending_remove = False
 
 
 class ModelRegistry:
@@ -99,10 +100,25 @@ class ModelRegistry:
         return self
 
     def remove(self, name: str) -> None:
+        """Unregister ``name``.  A removal racing a live pin lease (a
+        :class:`FleetRouter` / shadow engine, or a queued ``submit()``
+        reply) DEFERS like ``_offload``: the entry leaves the name space
+        immediately from the caller's point of view after the last pin
+        releases, and the engine is only stopped once no in-flight request
+        can still be computing on its buffers — popping eagerly here used
+        to orphan the entry (``_release`` found nothing and the engine
+        leaked, running, forever)."""
         with self._lock:
-            entry = self._entries.pop(name)
-        if entry.engine is not None:
-            entry.engine.stop()
+            entry = self._entries[name]
+            if entry.pins > 0:
+                # a lease still holds this version's device buffers:
+                # _release() completes the removal at pin zero
+                entry.pending_remove = True
+                return
+            del self._entries[name]
+            engine, entry.engine = entry.engine, None
+        if engine is not None:
+            engine.stop()
 
     def names(self):
         with self._lock:
@@ -157,6 +173,15 @@ class ModelRegistry:
             if entry is None:  # removed while in flight; nothing to free
                 return
             entry.pins = max(entry.pins - 1, 0)
+            if entry.pins == 0 and entry.pending_remove:
+                # complete the deferred remove(); engine.stop() is safe
+                # under the RLock (idempotent, self-join guarded)
+                entry.pending_remove = False
+                del self._entries[name]
+                engine, entry.engine = entry.engine, None
+                if engine is not None:
+                    engine.stop()
+                return
             if entry.pins == 0 and entry.pending_offload:
                 entry.pending_offload = False
                 if entry.engine is not None:
@@ -256,6 +281,7 @@ class ModelRegistry:
                 name: {
                     "resident": e.engine is not None,
                     "pins": e.pins,
+                    "pending_remove": e.pending_remove,
                     "hits": e.hits,
                     "activations": e.activations,
                     "last_used": e.last_used,

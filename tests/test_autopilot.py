@@ -1,0 +1,381 @@
+"""Closed-loop fleet tests (docs/autopilot.md): torn-free rolling hot
+swap under live traffic (every response computed by exactly ONE model
+version, zero compiles, zero drops — chaos ``swap_crash`` included),
+elastic width with ``scale_crash``, the :class:`Autopilot`'s deterministic
+scale/refresh/rollback drive, a chaos-killed refresh leaving the serving
+model untouched and retryable, and the registry's deferred ``remove()``
+under live pin leases."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import spark_ensemble_tpu as se
+from spark_ensemble_tpu.robustness.chaos import ChaosController, install
+from spark_ensemble_tpu.serving import (
+    Autopilot,
+    FleetRouter,
+    ModelRegistry,
+)
+from spark_ensemble_tpu.telemetry import record_fits
+from spark_ensemble_tpu.telemetry.events import compile_snapshot
+from spark_ensemble_tpu.telemetry.watchdog import Watchdog, default_rules
+
+ROUNDS = 4
+
+
+def _data(n=96, d=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X @ rng.randn(d) + 0.1 * rng.randn(n)).astype(np.float32)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    """Two distinguishable fitted GBMs shared across the module (their
+    predictions differ, so a response's bits identify its version)."""
+    X, y = _data()
+    v1 = se.GBMRegressor(num_base_learners=ROUNDS, seed=0).fit(X, y)
+    v2 = se.GBMRegressor(num_base_learners=2, seed=0).fit(X, y)
+    return X, y, v1, v2
+
+
+@pytest.fixture(autouse=True)
+def _deterministic_chaos():
+    # pin a never-fires controller so env-configured chaos tiers cannot
+    # perturb the exact counters; tests install their own controllers
+    install(ChaosController(seed=0, rate=0.0))
+    yield
+    install(None)
+
+
+def _registry_fleet(fitted, replicas=3, capacity=4):
+    X, y, v1, v2 = fitted
+    reg = ModelRegistry(capacity=capacity, min_bucket=8, max_batch_size=16)
+    reg.register("prod", v1, warm=True)
+    reg.register("v2", v2, warm=True)
+    fleet = FleetRouter.from_registry(
+        reg, "prod", replicas=replicas, deadline_ms=30_000.0,
+    )
+    return reg, fleet
+
+
+def _snapshot(p99=1.0, hedge=0.0, psi=0.0, div=0.0):
+    """Synthetic watchdog registry snapshot: one fleet source + one
+    quality source, shaped like ``global_metrics().snapshot()``."""
+    return {
+        "fleet/x": {"type": "source", "value": {
+            "p99_ms": p99, "hedge_rate": hedge,
+            "compiles_since_warmup": 0.0,
+        }},
+        "quality/q": {"type": "source", "value": {
+            "psi_max": psi, "divergence": div,
+        }},
+    }
+
+
+def _watchdog():
+    return Watchdog(
+        rules=default_rules(breach_for=1, clear_for=1), interval_s=3600.0
+    )
+
+
+# ---------------------------------------------------------------------------
+# torn-free rolling swap under live traffic (+ chaos swap_crash)
+# ---------------------------------------------------------------------------
+
+
+def test_swap_under_load_is_torn_free_and_zero_compile(fitted):
+    """The tentpole invariant, chaos-proven: a rolling swap with a
+    ``swap_crash`` killing one replica mid-rebind still serves every
+    response from exactly ONE whole model version (its bits match one
+    version's prediction exactly — clones share programs, so equal inputs
+    give equal bits), drops nothing, and compiles nothing."""
+    X, y, v1, v2 = fitted
+    Xq = X[:4]
+    install(ChaosController(seed=5, rate=1.0, faults=("swap_crash",)))
+    reg, fleet = _registry_fleet(fitted)
+    try:
+        want0 = np.asarray(fleet.predict(Xq).value)
+        results, errors = [], []
+        stop = threading.Event()
+
+        def loadgen():
+            while not stop.is_set():
+                try:
+                    r = fleet.predict(Xq)
+                    results.append((r.version, np.asarray(r.value)))
+                except Exception as e:  # noqa: BLE001 - collected, asserted empty
+                    errors.append(e)
+
+        threads = [threading.Thread(target=loadgen) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)
+        c0, _ = compile_snapshot()
+        info = fleet.swap_model("v2")
+        time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        want1 = np.asarray(fleet.predict(Xq).value)
+
+        assert not errors  # zero dropped / failed requests
+        assert info["version"] == 1 and info["model"] == "v2"
+        assert info["swap_compiles"] == 0  # registry engines pre-warmed
+        assert info["swap_crashes"] == 1  # the chaos kill actually landed
+        assert compile_snapshot()[0] == c0
+        assert not np.array_equal(want0, want1)  # versions distinguishable
+        want = {0: want0, 1: want1}
+        assert results and {v for v, _ in results} <= {0, 1}
+        for version, value in results:
+            # whole-version bits: never a torn (mixed-version) response
+            np.testing.assert_array_equal(value, want[version])
+        snap = fleet.slo_snapshot()
+        assert snap["version"] == 1 and snap["swaps"] == 1
+        assert all(
+            r["version"] == 1 and r["state"] == "healthy"
+            for r in snap["replicas"].values()
+        )
+    finally:
+        fleet.stop()
+        reg.close()
+
+
+def test_swap_rejects_incompatible_width(fitted):
+    X, y, v1, _ = fitted
+    narrow = se.GBMRegressor(num_base_learners=2, seed=0).fit(X[:, :3], y)
+    with FleetRouter(
+        v1, replicas=1, min_bucket=8, max_batch_size=16,
+        deadline_ms=30_000.0,
+    ) as fleet:
+        with pytest.raises(ValueError, match="num_features"):
+            fleet.swap_model(narrow)
+        assert fleet.slo_snapshot()["version"] == 0  # nothing changed
+
+
+# ---------------------------------------------------------------------------
+# elastic width (+ chaos scale_crash): zero dropped, zero duplicated
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_scale_zero_drop_under_scale_crash(fitted):
+    X, y, v1, _ = fitted
+    want = np.asarray(v1.predict(X[:4]))
+    install(ChaosController(seed=2, rate=1.0, faults=("scale_crash",)))
+    with FleetRouter(
+        v1, replicas=2, min_bucket=8, max_batch_size=16,
+        deadline_ms=30_000.0, shed_depth=10_000,
+    ) as fleet:
+        futs = [fleet.submit(X[:4]) for _ in range(30)]
+        added = fleet.add_replica()  # chaos kills the warm-in; re-clones
+        futs += [fleet.submit(X[:4]) for _ in range(30)]
+        removed = fleet.remove_replica(added)
+        futs += [fleet.submit(X[:4]) for _ in range(10)]
+        responses = [f.result(timeout=60) for f in futs]
+        assert len(responses) == 70  # zero lost; Futures resolve once
+        for r in responses:
+            np.testing.assert_allclose(
+                r.value, want, rtol=1e-5, atol=1e-6
+            )
+        assert removed == added
+        snap = fleet.slo_snapshot()
+        assert snap["crashes"] == 1  # the warm-in kill was recorded
+        assert snap["scale_ups"] == 1 and snap["scale_downs"] == 1
+        assert len(snap["replicas"]) == 2
+        assert snap["compiles_since_warmup"] == 0  # clones share programs
+    assert fleet.slo_snapshot  # context-exit stop() is clean
+
+
+def test_remove_last_replica_refused(fitted):
+    X, y, v1, _ = fitted
+    with FleetRouter(
+        v1, replicas=1, min_bucket=8, max_batch_size=16,
+    ) as fleet:
+        with pytest.raises(ValueError, match="last replica"):
+            fleet.remove_replica()
+
+
+# ---------------------------------------------------------------------------
+# autopilot: the deterministic scale/refresh/rollback drive
+# ---------------------------------------------------------------------------
+
+
+def test_autopilot_scales_refreshes_and_rolls_back(fitted):
+    """One full closed loop, tick by tick: p99 breach scales up, drift
+    triggers a warm-start refresh rolled on torn-free (zero compiles),
+    shadow divergence rolls back to the pinned previous version, calm
+    scales back down — each action traced as a ``fleet_action`` event."""
+    X, y, v1, v2 = fitted
+    reg, fleet = _registry_fleet(fitted, replicas=2)
+    pilot = Autopilot(
+        fleet, _watchdog(), refresh_data=lambda: (X, y),
+        refresh_rounds=2, min_replicas=2, max_replicas=4,
+        calm_ticks=2, background_refresh=False,
+    )
+    try:
+        want_prod = np.asarray(fleet.predict(X[:4]).value)
+        with record_fits() as rec:
+            assert pilot.step(_snapshot()) == []  # healthy: no action
+            a2 = pilot.step(_snapshot(p99=9999.0))
+            assert [a["action"] for a in a2] == ["scale_up"]
+            assert a2[0]["trigger"] == "serving_p99_ms"
+            assert len(fleet.slo_snapshot()["replicas"]) == 3
+
+            a3 = pilot.step(_snapshot(psi=0.9))
+            assert [a["action"] for a in a3] == ["refresh"]
+            ref = a3[0]
+            assert ref["status"] == "ok"
+            assert ref["model"] == "prod@v1" and "prod@v1" in reg
+            assert ref["swap_compiles"] == 0
+            assert ref["members"] == ROUNDS + 2  # fit_resume added rounds
+            assert fleet.predict(X[:4]).version == 1
+            assert pilot.statusz()["rollback_pin"] == "prod"
+
+            a4 = pilot.step(_snapshot(div=0.9))
+            assert [a["action"] for a in a4] == ["rollback"]
+            assert a4[0]["status"] == "ok" and a4[0]["target"] == "prod"
+            assert fleet.predict(X[:4]).version == 2
+            np.testing.assert_array_equal(  # back on prod's exact bits
+                np.asarray(fleet.predict(X[:4]).value), want_prod
+            )
+            assert pilot.statusz()["rollback_pin"] is None
+
+            assert pilot.step(_snapshot()) == []  # calm 1/2
+            a6 = pilot.step(_snapshot())          # calm 2/2
+            assert [a["action"] for a in a6] == ["scale_down"]
+            assert len(fleet.slo_snapshot()["replicas"]) == 2
+        events = [e for e in rec.events if e["event"] == "fleet_action"]
+        assert [e["action"] for e in events] == [
+            "scale_up", "refresh", "rollback", "scale_down",
+        ]
+        assert all(
+            e["status"] == "ok" and e["flow"] and e["trigger"]
+            for e in events
+        )
+        st = pilot.statusz()
+        assert st["steps"] == 6 and st["refresh_generation"] == 1
+        assert not st["refresh_inflight"]
+    finally:
+        pilot.stop()
+        fleet.stop()
+        reg.close()
+
+
+def test_autopilot_respects_replica_bounds(fitted):
+    X, y, _, _ = fitted
+    reg, fleet = _registry_fleet(fitted, replicas=2)
+    pilot = Autopilot(
+        fleet, _watchdog(), min_replicas=2, max_replicas=2,
+        calm_ticks=1, background_refresh=False,
+    )
+    try:
+        # pressure cannot scale past max; calm cannot drop below min
+        assert pilot.step(_snapshot(p99=9999.0)) == []
+        assert pilot.step(_snapshot()) == []
+        assert pilot.step(_snapshot()) == []
+        assert len(fleet.slo_snapshot()["replicas"]) == 2
+    finally:
+        pilot.stop()
+        fleet.stop()
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos refresh_crash through the autopilot: untouched + retryable
+# ---------------------------------------------------------------------------
+
+
+def test_refresh_crash_leaves_serving_model_untouched_and_retries(fitted):
+    X, y, v1, _ = fitted
+    ctl = ChaosController(seed=11, rate=1.0, faults=("refresh_crash",))
+    install(ctl)
+    reg, fleet = _registry_fleet(fitted, replicas=2)
+    pilot = Autopilot(
+        fleet, _watchdog(), refresh_data=lambda: (X, y),
+        refresh_rounds=2, min_replicas=2, max_replicas=2,
+        background_refresh=False,
+    )
+    try:
+        base_before = fleet._base
+        want = np.asarray(fleet.predict(X[:4]).value)
+        a1 = pilot.step(_snapshot(psi=0.9))
+        assert [a["action"] for a in a1] == ["refresh"]
+        assert a1[0]["status"] == "failed"  # the chaos kill landed...
+        assert ctl.fired and ctl.fired[0][0] == "refresh_crash"
+        # ...and nothing moved: same engine object, same registry names,
+        # same served version, byte-identical responses
+        assert fleet._base is base_before
+        assert sorted(reg.names()) == ["prod", "v2"]
+        resp = fleet.predict(X[:4])
+        assert resp.version == 0
+        np.testing.assert_array_equal(np.asarray(resp.value), want)
+        assert not pilot.statusz()["refresh_inflight"]  # retryable
+
+        # second drift tick retries from the SAME committed state (the
+        # fault's budget is spent) and completes the roll
+        a2 = pilot.step(_snapshot(psi=0.9))
+        assert [a["action"] for a in a2] == ["refresh"]
+        assert a2[0]["status"] == "ok" and "prod@v1" in reg
+        assert fleet.predict(X[:4]).version == 1
+        assert pilot.statusz()["refresh_generation"] == 1
+    finally:
+        pilot.stop()
+        fleet.stop()
+        reg.close()
+
+
+# ---------------------------------------------------------------------------
+# registry: remove() racing a live pin lease defers like _offload
+# ---------------------------------------------------------------------------
+
+
+def test_registry_remove_defers_until_pins_release(fitted):
+    """Regression: ``remove()`` used to pop the entry eagerly, so the
+    pin-zero ``_release`` found nothing and the engine leaked, running,
+    forever.  Now a removal racing a lease defers: the entry survives
+    (and re-registration still conflicts) until the last pin releases,
+    then the entry leaves and the engine stops."""
+    X, y, v1, v2 = fitted
+    with ModelRegistry(capacity=4, min_bucket=8, max_batch_size=16) as reg:
+        reg.register("a", v1)
+        reg.register("b", v2)
+        want = np.asarray(reg.predict("a", X[:4]))
+        with reg.lease("a") as eng:
+            reg.remove("a")
+            st = reg.stats()["a"]
+            assert st["pending_remove"] and st["pins"] == 1
+            assert "a" in reg  # still conflicts: no name reuse mid-flight
+            with pytest.raises(ValueError, match="already registered"):
+                reg.register("a", v2)
+            # the leased engine still serves the pinned buffers
+            np.testing.assert_array_equal(
+                np.asarray(eng.predict(X[:4])), want
+            )
+        assert "a" not in reg and len(reg) == 1  # completed at pin zero
+
+        # same race through the async path: a queued submit pins the
+        # version; the reply is served, THEN the deferred remove lands
+        want_b = np.asarray(reg.predict("b", X[:4]))
+        fut = reg.submit("b", X[:4])
+        reg.remove("b")
+        np.testing.assert_array_equal(
+            np.asarray(fut.result(timeout=30)), want_b
+        )
+        deadline = time.time() + 10.0
+        while "b" in reg and time.time() < deadline:
+            time.sleep(0.005)
+        assert "b" not in reg and len(reg) == 0
+
+
+def test_registry_remove_unpinned_is_immediate(fitted):
+    X, y, v1, _ = fitted
+    with ModelRegistry(capacity=2, min_bucket=8, max_batch_size=16) as reg:
+        reg.register("a", v1, warm=True)
+        reg.remove("a")
+        assert "a" not in reg and len(reg) == 0
+        with pytest.raises(KeyError):
+            reg.engine("a")

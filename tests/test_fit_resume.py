@@ -1,0 +1,223 @@
+"""Warm-start resume tests (docs/autopilot.md): ``fit_resume`` on every
+stagewise family is PINNED bit-identical to a single longer fit — the
+committed rounds are replayed host-free, the fresh fit re-enters the round
+loop at the next absolute round index, and round keys/masks derive from
+absolute indices so the larger plan is prefix-stable.  Also: the packed
+round-trip (``take(k)`` -> ``fit_resume`` -> ``pack``), SAMME's terminal
+convergence no-op, the pipelined variant, and a chaos ``refresh_crash``
+mid-resume kill leaving the source model untouched and the resume
+retryable."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import spark_ensemble_tpu as se
+from spark_ensemble_tpu.robustness import chaos
+from spark_ensemble_tpu.robustness.chaos import (
+    ChaosController,
+    ChaosPreemption,
+)
+from spark_ensemble_tpu.serving import export, pack
+
+K = 3       # committed rounds in the short fit
+N_NEW = 3   # rounds added by the resume
+N = K + N_NEW
+
+
+def _reg_data(n=96, d=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X @ rng.randn(d) + 0.1 * rng.randn(n)).astype(np.float32)
+    return X, y
+
+
+def _cls_data(n=96, d=5, seed=0, noisy=True):
+    """3-class labels; ``noisy`` flips every 7th label so no tiny tree is
+    perfect — SAMME's ``err <= 0`` early stop is a separate test."""
+    X, y = _reg_data(n, d, seed)
+    yc = np.digitize(y, np.quantile(y, [1 / 3, 2 / 3])).astype(np.float32)
+    if noisy:
+        yc[::7] = (yc[::7] + 1) % 3
+    return X, yc
+
+
+# family -> (estimator factory, data factory); every stagewise family the
+# ISSUE names, with the weight recursions that make resume non-trivial:
+# GBM cls with optimized line-search weights + non-unit lr (alpha_ws
+# recovery), SAMME discrete + real (bw replay), Drucker (loss shaping)
+FAMILIES = {
+    "gbm_reg": (
+        lambda n, **kw: se.GBMRegressor(num_base_learners=n, seed=0, **kw),
+        _reg_data,
+    ),
+    "gbm_cls": (
+        lambda n, **kw: se.GBMClassifier(
+            num_base_learners=n, seed=0, learning_rate=0.5,
+            optimized_weights=True, **kw,
+        ),
+        _cls_data,
+    ),
+    "samme_discrete": (
+        lambda n, **kw: se.BoostingClassifier(
+            num_base_learners=n, seed=0, algorithm="discrete", **kw,
+        ),
+        _cls_data,
+    ),
+    "samme_real": (
+        lambda n, **kw: se.BoostingClassifier(
+            num_base_learners=n, seed=0, algorithm="real", **kw,
+        ),
+        _cls_data,
+    ),
+    "drucker": (
+        lambda n, **kw: se.BoostingRegressor(
+            num_base_learners=n, seed=0, **kw,
+        ),
+        _reg_data,
+    ),
+}
+
+
+def _assert_bit_identical(resumed, full, X):
+    assert resumed.num_members == full.num_members
+    fa, ta = jax.tree_util.tree_flatten(resumed.params)
+    fb, tb = jax.tree_util.tree_flatten(full.params)
+    assert ta == tb
+    for a, b in zip(fa, fb):
+        assert np.array_equal(
+            np.asarray(a), np.asarray(b), equal_nan=True
+        )
+    np.testing.assert_array_equal(
+        np.asarray(resumed.predict(X)), np.asarray(full.predict(X))
+    )
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_chaos():
+    yield
+    chaos.install(None)
+
+
+# ---------------------------------------------------------------------------
+# the pin: resume k -> n is bit-identical to a straight n-round fit
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_fit_resume_bit_identical_to_longer_fit(family):
+    make, data = FAMILIES[family]
+    X, y = data()
+    full = make(N).fit(X, y)
+    short = make(K).fit(X, y)
+    resumed = short.fit_resume(X, y, N_NEW)
+    _assert_bit_identical(resumed, full, X)
+    # the source model was never mutated: another resume from the same
+    # committed state reproduces the same result (idempotent refresh)
+    assert short.num_members == K
+    again = short.fit_resume(X, y, N_NEW)
+    _assert_bit_identical(again, full, X)
+
+
+@pytest.mark.parametrize("family", ["gbm_reg", "samme_real"])
+def test_fit_resume_pipelined_bit_identical(monkeypatch, family):
+    """The lookahead pipeline speculates chunks but commits the same round
+    math: resuming under ``SE_TPU_PIPELINE=1`` (chunked so the pipeline
+    actually overlaps) still lands bit-identical to the straight fit."""
+    monkeypatch.setenv("SE_TPU_PIPELINE", "1")
+    make, data = FAMILIES[family]
+    X, y = data()
+    full = make(N, scan_chunk=2).fit(X, y)
+    short = make(K, scan_chunk=2).fit(X, y)
+    resumed = short.fit_resume(X, y, N_NEW)
+    _assert_bit_identical(resumed, full, X)
+
+
+def test_fit_resume_samme_converged_is_noop():
+    """SAMME's ``err <= 0`` rule KEEPS the perfect member then stops; the
+    carried weights alone cannot reveal that stop (beta=0 leaves bw
+    positive), so ``fit_resume`` replays the final round error and
+    returns the model unchanged — exactly what the longer fit produces."""
+    X, _ = _reg_data()
+    yc = (X[:, 0] > 0).astype(np.float32)  # one tree fits this perfectly
+    make = FAMILIES["samme_discrete"][0]
+    short = make(K).fit(X, yc)
+    full = make(N).fit(X, yc)
+    assert full.num_members == short.num_members  # the driver also stopped
+    resumed = short.fit_resume(X, yc, N_NEW)
+    assert resumed is short  # terminal convergence: resume is a no-op
+    _assert_bit_identical(resumed, full, X)
+
+
+def test_fit_resume_validates_args():
+    X, y = _reg_data()
+    model = se.GBMRegressor(num_base_learners=2, seed=0).fit(X, y)
+    with pytest.raises(ValueError, match="n_new_rounds"):
+        model.fit_resume(X, y, 0)
+    with pytest.raises(ValueError, match="original training matrix"):
+        model.fit_resume(X[:, :3], y, 2)
+
+
+# ---------------------------------------------------------------------------
+# packed round-trip: take(k) -> fit_resume -> pack
+# ---------------------------------------------------------------------------
+
+
+def test_packed_take_fit_resume_roundtrip():
+    """The serving refresh path end to end: slice a served prefix with
+    ``take(k)``, resume it for the remaining rounds, repack — bit-identical
+    predictions to packing the straight n-round fit."""
+    X, y = _reg_data()
+    full = se.GBMRegressor(num_base_learners=N, seed=0).fit(X, y)
+    p_full = pack(full)
+    refreshed = export.fit_resume(p_full.take(K), X, y, N_NEW)
+    assert refreshed.num_members == N
+    np.testing.assert_array_equal(
+        np.asarray(refreshed.predict(X)), np.asarray(p_full.predict(X))
+    )
+
+
+def test_export_fit_resume_rejects_nonstagewise():
+    X, y = _reg_data()
+    bag = pack(se.BaggingRegressor(num_base_learners=2).fit(X, y))
+    with pytest.raises(TypeError, match="stagewise"):
+        export.fit_resume(bag, X, y, 2)
+
+
+# ---------------------------------------------------------------------------
+# chaos: a killed refresh fit leaves the source model untouched
+# ---------------------------------------------------------------------------
+
+
+def test_refresh_crash_leaves_source_untouched_and_retryable():
+    """The autopilot's crash contract at the model layer: chaos
+    ``refresh_crash`` kills the resume mid-round; the committed model is
+    byte-identical afterwards, a NORMAL fit never sees the fault (the
+    site only exists on refresh fits), and the retry — with the
+    controller still installed — succeeds bit-identically (at-most-once
+    per site + budget)."""
+    X, y = _reg_data()
+    full = se.GBMRegressor(num_base_learners=N, seed=0).fit(X, y)
+    short = se.GBMRegressor(num_base_learners=K, seed=0).fit(X, y)
+    before = [
+        np.asarray(v).copy()
+        for v in jax.tree_util.tree_flatten(short.params)[0]
+    ]
+    ctl = ChaosController(
+        seed=11, rate=1.0, faults=("refresh_crash",),
+    )
+    chaos.install(ctl)
+    # a plain (non-refresh) fit is immune: no refresh sites are exposed
+    se.GBMRegressor(num_base_learners=2, seed=1).fit(X, y)
+    assert not ctl.fired
+    with pytest.raises(ChaosPreemption):
+        short.fit_resume(X, y, N_NEW)
+    assert ctl.fired and ctl.fired[0][0] == "refresh_crash"
+    after = jax.tree_util.tree_flatten(short.params)[0]
+    assert short.num_members == K
+    for a, b in zip(before, after):
+        assert np.array_equal(a, np.asarray(b), equal_nan=True)
+    # retry under the SAME controller: the fault fired its budget
+    resumed = short.fit_resume(X, y, N_NEW)
+    _assert_bit_identical(resumed, full, X)

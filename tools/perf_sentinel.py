@@ -77,6 +77,15 @@ METRICS: Dict[str, Any] = {
     # steady-state serve cost, drift-on vs drift-off over warm programs on
     # the fleet leg — 2.0 abs = the <2% budget (docs/quality.md#overhead)
     "drift_overhead_pct":         ("lower", 0.50, 2.0),
+    # self-healing fleet (docs/autopilot.md): closed-loop p99 during a
+    # rolling hot swap + elastic add/remove vs the clean leg (ratio of two
+    # noisy p99s — wide floors), the add_replica warm-in wall (a clone of
+    # warm programs, so it must stay ~instant), and requests dropped
+    # across swap/scale — an exact invariant like the compile contract:
+    # zero, no floor
+    "swap_p99_ratio":             ("lower", 0.50, 1.0),
+    "scale_up_warm_ms":           ("lower", 0.50, 50.0),
+    "dropped_requests":           ("lower", 0.0, 0.0),
 }
 
 

@@ -32,7 +32,21 @@ configures (SE_TPU_CHAOS + serving faults):
         stall+crash window must flip /healthz to 503 (and recovery must
         flip it back), and the validated snapshot files land in DIR.
 
-A fourth subcommand drives the model-quality observability plane (same
+A fourth subcommand drives the closed-loop control plane (same CI job;
+docs/autopilot.md):
+
+    python tools/serving_smoke.py swap --out DIR [--telemetry PATH]
+        Load the artifact into a registry twice (full model + a prefix
+        "next" version), serve multi-threaded traffic through a
+        registry-backed fleet, and roll a torn-free hot swap plus one
+        add/remove elastic cycle mid-load — WITH deterministic
+        ``swap_crash``/``scale_crash`` chaos killing a replica mid-rebind
+        and a warm-in.  Asserts ZERO failed requests, ZERO compiles
+        (registry engines are pre-warmed, clones share programs), every
+        response bit-matching exactly ONE version, and every request
+        started after the swap returning the new version.
+
+A fifth subcommand drives the model-quality observability plane (same
 CI job; docs/quality.md):
 
     python tools/serving_smoke.py quality --out DIR [--telemetry PATH]
@@ -414,6 +428,120 @@ def cmd_fleet(args):
     }))
 
 
+def cmd_swap(args):
+    """The hot-swap acceptance arc (CI `serving-chaos` job;
+    docs/autopilot.md): a rolling registry swap + one elastic cycle under
+    live multi-threaded traffic and deterministic control-plane chaos,
+    proving the tentpole invariants — no torn responses, no drops, no
+    compiles."""
+    import threading
+
+    from spark_ensemble_tpu.robustness.chaos import ChaosController, install
+    from spark_ensemble_tpu.serving import FleetRouter, ModelRegistry, load_packed
+    from spark_ensemble_tpu.telemetry.events import compile_snapshot
+
+    expected = np.load(os.path.join(args.out, "expected.npz"))
+    X = expected["X"]
+    packed = load_packed(os.path.join(args.out, "model"))
+    tier = max(1, packed.num_members // 2)
+
+    # the env-configured controller stays for the serve path; the swap
+    # sites get their own deterministic kills (rate 1.0, budget 1 each)
+    install(ChaosController(
+        seed=5, rate=1.0, faults=("swap_crash", "scale_crash"),
+    ))
+    registry = ModelRegistry(
+        capacity=4, max_batch_size=256,
+        # proba bits distinguish the versions (a prefix classifier often
+        # agrees with the full model on argmax labels)
+        methods=("predict", "predict_proba"),
+        telemetry_path=args.telemetry,
+    )
+    registry.register("prod", packed, warm=True)
+    # "next" is the refreshed generation: a prefix slice distinguishes the
+    # versions bit-wise without a second fit
+    registry.register("next", packed.take(tier), warm=True)
+    router = FleetRouter.from_registry(
+        registry, "prod", replicas=int(args.replicas),
+        deadline_ms=10_000.0, telemetry_path=args.telemetry,
+        label="swap-fleet",
+    )
+    n_req, n_threads, batch = int(args.requests), 4, 32
+    want = {0: np.asarray(
+        router.predict(X[:batch], method="predict_proba").value
+    )}
+    swapped = threading.Event()
+    failed = [0]
+    results = [[] for _ in range(n_threads)]
+
+    def worker(tid):
+        for _ in range(n_req // n_threads):
+            after = swapped.is_set()  # sampled BEFORE the request starts
+            try:
+                resp = router.predict(X[:batch], method="predict_proba")
+            except Exception:  # noqa: BLE001 - counted; zero is the bar
+                failed[0] += 1
+                continue
+            results[tid].append(
+                (after, resp.version, np.asarray(resp.value))
+            )
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(n_threads)
+    ]
+    c0, _ = compile_snapshot()
+    for t in threads:
+        t.start()
+    info = router.swap_model("next")
+    swapped.set()
+    added = router.add_replica()
+    removed = router.remove_replica(added)
+    for t in threads:
+        t.join(timeout=600)
+    want[1] = np.asarray(
+        router.predict(X[:batch], method="predict_proba").value
+    )
+    snap = router.slo_snapshot()
+    router.stop()
+    registry.close()
+    install(None)  # hand the env-configured controller back
+
+    assert failed[0] == 0, f"{failed[0]} requests failed during the swap"
+    assert info["swap_compiles"] == 0, info
+    assert info["swap_crashes"] == 1, info  # the mid-rebind kill landed
+    assert snap["crashes"] >= 2, snap       # + the warm-in kill
+    assert snap["compiles_since_warmup"] == 0, snap
+    assert compile_snapshot()[0] == c0
+    assert snap["version"] == 1 and snap["swaps"] == 1
+    assert not np.array_equal(want[0], want[1])
+    total = 0
+    for rows in results:
+        for after, version, value in rows:
+            total += 1
+            assert version in want, version
+            # whole-version bits: never a torn (mixed-version) response
+            assert np.array_equal(value, want[version]), (
+                f"torn response: version {version} bits match neither model"
+            )
+            if after:  # monotone: post-swap requests serve the new version
+                assert version == 1, "stale version served after the swap"
+    assert total == sum(len(r) for r in results)
+    print(json.dumps({
+        "requests": snap["requests"],
+        "failed": failed[0],
+        "swap": info,
+        "scale": {"added": added, "removed": removed},
+        "crashes": snap["crashes"],
+        "post_swap_monotone": True,
+        "versions_seen": sorted({
+            v for rows in results for _, v, _ in rows
+        }),
+        "compiles_since_warmup": snap["compiles_since_warmup"],
+        "pid": os.getpid(),
+        "telemetry": args.telemetry,
+    }))
+
+
 def cmd_quality(args):
     """The model-quality acceptance arc (CI `serving-chaos` job;
     docs/quality.md), fully deterministic: serve in-distribution traffic
@@ -642,6 +770,12 @@ def main(argv=None):
         "flips deterministically",
     )
     p_fleet.set_defaults(fn=cmd_fleet)
+    p_swap = sub.add_parser("swap")
+    p_swap.add_argument("--out", required=True)
+    p_swap.add_argument("--telemetry", default=None)
+    p_swap.add_argument("--replicas", type=int, default=3)
+    p_swap.add_argument("--requests", type=int, default=200)
+    p_swap.set_defaults(fn=cmd_swap)
     p_quality = sub.add_parser("quality")
     p_quality.add_argument("--out", required=True)
     p_quality.add_argument("--telemetry", default=None)
