@@ -20,6 +20,7 @@ import os
 import subprocess
 import sys
 import time
+from datetime import datetime, timezone
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
 
@@ -265,11 +266,22 @@ def main():
 
 
 def _load_last_tpu_capture():
-    """The committed real-chip capture, if any (see CPU-fallback note)."""
+    """The committed real-chip capture, if any (see CPU-fallback note).
+
+    Replayed legs are STAMPED: every ``last_tpu`` embed carries
+    ``tpu_capture_stale: true`` plus the capture file's mtime, so a
+    BENCH_r*.json reader can tell a months-old replay (e.g. the pre-fused
+    mfu_est ≈ 0.005 capture riding along since r03) from fresh real-chip
+    numbers — the numbers describe the capture's commit, not this run."""
     path = os.path.join(_REPO, "BENCH_TPU_CAPTURE.json")
     try:
         with open(path) as f:
-            return json.load(f)
+            capture = json.load(f)
+        capture["tpu_capture_stale"] = True
+        capture["tpu_capture_mtime"] = datetime.fromtimestamp(
+            os.path.getmtime(path), tz=timezone.utc
+        ).isoformat(timespec="seconds")
+        return capture
     except (OSError, json.JSONDecodeError):
         return None
 
@@ -1450,6 +1462,94 @@ def inner():
         out["multihost_rows_per_sec"] = multihost["rows_per_sec"]
         out["dcn_reduce_share"] = multihost["dcn_reduce_share"]
         out["pod_skew_ratio"] = multihost["pod_skew_ratio"]
+
+    # megabatch sweep leg (docs/selection.md#megabatch-sweeps): the SAME
+    # 32-candidate hyperparameter sweep fit twice — one est.fit() per
+    # candidate (warm programs; the traced-lr contract means sequential
+    # recompiles nothing between candidates) vs fit_sweep() vmapping all
+    # candidates over a config axis into one batched dispatch per round
+    # chunk.  Both legs run identical round math; the quantity megabatch
+    # exists to move is PER-DISPATCH overhead (round launch + the guard's
+    # blocking readback, paid 32x per round sequentially and once
+    # batched), so the leg runs the dispatch-bound regime that dominates
+    # real sweeps: tiny per-candidate rounds at scan_chunk=1.  Results
+    # are pinned bit-identical (spot-checked here on a prediction probe,
+    # contract-pinned in tests/test_megabatch.py).
+    # tools/perf_sentinel.py floors sweep_speedup vs PERF_BASELINE.json.
+    sweep_ab = {}
+    try:
+        from spark_ensemble_tpu import GBMRegressor
+        from spark_ensemble_tpu.autotune import resolve as _tuned
+        from spark_ensemble_tpu.models.gbm_sweep import (
+            _CONFIGS_PER_DISPATCH,
+            fit_sweep,
+        )
+
+        sw_rows, sw_rounds = 128, 16
+        sw_rng = np.random.default_rng(7)
+        Xsw = sw_rng.normal(size=(sw_rows, 8)).astype(np.float32)
+        ysw = (
+            Xsw[:, 0] * 2.0 + np.sin(Xsw[:, 1])
+        ).astype(np.float32)
+        sw_base = GBMRegressor(
+            num_base_learners=sw_rounds,
+            loss="squared",
+            base_learner=DecisionTreeRegressor(max_depth=2),
+            scan_chunk=1,
+        )
+        n_cfgs = 32
+        sw_ests = [
+            sw_base.copy(learning_rate=0.05 + 0.01 * i, seed=i,
+                         subsample_ratio=0.8)
+            for i in range(n_cfgs)
+        ]
+        # warm both legs at the TIMED shapes: one sequential fit compiles
+        # the shared round programs; a FULL-width sweep compiles the
+        # vmapped slab programs (slab width is a trace shape — warming at
+        # fewer candidates would leave the timed leg paying compile)
+        _block_on_model(sw_ests[0].copy().fit(Xsw, ysw))
+        for m in fit_sweep([e.copy() for e in sw_ests], Xsw, ysw):
+            _block_on_model(m)
+
+        t0 = time.perf_counter()
+        seq_models = [e.copy().fit(Xsw, ysw) for e in sw_ests]
+        for m in seq_models:
+            _block_on_model(m)
+        seq_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        mb_models = fit_sweep(sw_ests, Xsw, ysw)
+        for m in mb_models:
+            _block_on_model(m)
+        mb_s = time.perf_counter() - t0
+
+        probe = Xsw[:256]
+
+        def _bits(m):
+            return np.asarray(m.predict(probe))  # graftlint: ignore[unfenced-blocking-read] -- bit-identity probe after both timed legs, outside the dispatch window
+
+        identical = all(
+            np.array_equal(_bits(seq_models[i]), _bits(mb_models[i]))
+            for i in (0, n_cfgs // 2, n_cfgs - 1)
+        )
+        sweep_ab = {
+            "configs": n_cfgs,
+            "rows": sw_rows,
+            "rounds": sw_rounds,
+            "sequential_seconds": round(seq_s, 3),
+            "megabatch_seconds": round(mb_s, 3),
+            "speedup": round(seq_s / mb_s, 3),
+            "configs_per_dispatch": int(_tuned(
+                "configs_per_dispatch", _CONFIGS_PER_DISPATCH, n=sw_rows
+            )),
+            "bit_identical": bool(identical),
+        }
+    except Exception as e:  # noqa: BLE001 - carry, keep going
+        sweep_ab = {"error": str(e)[:200]}
+    out["sweep"] = sweep_ab
+    if "speedup" in sweep_ab:
+        out["sweep_speedup"] = sweep_ab["speedup"]
+        out["configs_per_dispatch"] = sweep_ab["configs_per_dispatch"]
 
     extras = {}
     if os.environ.get("BENCH_FULL") == "1":

@@ -768,6 +768,67 @@ def _trace_streaming_dist(report: ContractReport) -> None:
         )
 
 
+def _trace_megabatch(report: ContractReport) -> None:
+    """Trace the megabatch sweep engine (models/gbm_sweep.py).
+
+    The sweep contract: a candidate batch dispatches a FIXED set of
+    cached programs regardless of how many candidates it holds — lanes
+    travel the config axis of ONE vmapped round program per chunk shape,
+    so doubling the sweep re-enters the same compiled set instead of
+    tracing per candidate (the whole point of the megabatch refactor;
+    docs/selection.md#megabatch-sweeps).  Traced at 16 and 32 candidates
+    with 32 pinned as one slab (`configs_per_dispatch`); the 16-candidate
+    count pins the ``gbm_regressor.fit_sweep`` budget and any growth
+    between the two is a ``megabatch`` violation."""
+    from spark_ensemble_tpu.autotune import override
+    from spark_ensemble_tpu.models.base import observe_program_calls
+    from spark_ensemble_tpu.models.gbm_sweep import fit_sweep
+
+    import spark_ensemble_tpu as se
+
+    entry = "gbm_regressor.fit_sweep"
+    X, y = _canonical_data(False)
+    base = se.GBMRegressor(
+        base_learner=se.DecisionTreeRegressor(max_depth=3),
+        num_base_learners=3,
+        seed=0,
+    )
+    counts: Dict[int, int] = {}
+    for n_cands in (16, 32):
+        ests = [
+            base.copy(learning_rate=0.05 + 0.01 * i, seed=i)
+            for i in range(n_cands)
+        ]
+        rec = _ProgramRecorder()
+        try:
+            # both batches must run at ONE slab width: a 16-lane and a
+            # 32-lane slab are different chunk shapes (both O(1), but the
+            # growth check below wants identical program sets)
+            with override(configs_per_dispatch=16):
+                with observe_program_calls(rec):
+                    fit_sweep(ests, X, y)
+        except Exception as e:  # noqa: BLE001
+            report.skipped[entry] = f"sweep not traceable: {e!r:.120}"
+            return
+        counts[n_cands] = rec.count()
+        for (tag, _), jaxpr in rec.programs.items():
+            if jaxpr is not None:
+                _check_jaxpr(entry, tag, jaxpr, report.violations)
+    (c_a, count_a), (c_b, count_b) = sorted(counts.items())
+    report.budgets[entry] = count_a
+    if count_a != count_b:
+        report.violations.append(
+            ContractViolation(
+                "megabatch",
+                entry,
+                f"program count grew with candidate count ({c_a} "
+                f"candidates: {count_a} programs, {c_b}: {count_b}): the "
+                "sweep must batch candidates over the vmapped config "
+                "axis, not trace per candidate",
+            )
+        )
+
+
 def _trace_tracing(report: ContractReport) -> None:
     """Trace the causal-tracing plane's own budget (telemetry/trace.py).
 
@@ -1036,6 +1097,8 @@ def trace_contracts(
             _trace_streaming(report)
         if wanted is None or "distributed" in wanted:
             _trace_streaming_dist(report)
+        if wanted is None or "megabatch" in wanted:
+            _trace_megabatch(report)
         if wanted is None or "tracing" in wanted:
             _trace_tracing(report)
         if wanted is None or "operator" in wanted:

@@ -180,6 +180,13 @@ TUNABLES = TunableSpace((
         site="execution.py:resolve_pipeline_depth",
         kind="choice",
     ),
+    Tunable(
+        "configs_per_dispatch", 32, (8, 16, 32, 64),
+        doc="sweep candidates vmapped into one megabatch round dispatch "
+        "(tuning.py megabatch; candidates beyond it run in further "
+        "slabs of the same program shape)",
+        site="models/gbm_sweep.py:_CONFIGS_PER_DISPATCH",
+    ),
 ))
 
 

@@ -677,6 +677,286 @@ def _pseudo_residuals_and_weights(
     return labels, fit_w, bag_w
 
 
+def _make_reg_loss(loss_name, alpha_q, delta):
+    """Loss factory snapshot shared by the sequential chunk programs and the
+    megabatch sweep (models/gbm_sweep.py): pure-function so cached closures
+    never read estimator state at (re)trace time."""
+    if loss_name == "huber":
+        return losses_mod.HuberLoss(delta)
+    return losses_mod.get_regression_loss(
+        loss_name, alpha=alpha_q, quantile=alpha_q
+    )
+
+
+def make_reg_round_core(
+    base, loss_name, alpha_q, updates, optimized, goss, tol, max_iter,
+    ax=None,
+):
+    """One regressor boosting round as a pure function of traced inputs.
+
+    ``lr`` enters as the LAST argument (not a closure constant): the
+    multiply ``(lr * alpha_opt) * scale`` builds the identical f32
+    expression tree either way, so the change is bit-exact — and it lets
+    the megabatch sweep ``vmap`` one program over candidates that differ
+    only in learning rate (and in the data-borne seed/subsample/subspace
+    draws).  Single source of round math for the sequential fit, the mesh
+    fit, and ``models/gbm_sweep.py``."""
+
+    def round_core(ctx, X, bag_w, key, mask, pred, delta, y, w, scale, lr):
+        loss = _make_reg_loss(loss_name, alpha_q, delta)
+        y_enc = loss.encode_label(y)
+        labels, fit_w, bag_w = _pseudo_residuals_and_weights(
+            loss, updates, y_enc, pred[:, None], bag_w, w,
+            axis_name=ax, goss=goss,
+            goss_key=jax.random.fold_in(key, 7),
+        )
+        # fit + same-row predictions in one protocol call: tree
+        # learners reuse the leaf ids their fit computed instead
+        # of re-routing every row (models/tree.py)
+        params, direction = base.fit_and_direction(
+            ctx, labels[:, 0], fit_w[:, 0], mask, key, X,
+            axis_name=ax,
+        )
+        if optimized and loss_name == "squared":
+            # phi(a) = sum bw*(res - a*dir)^2/2 is EXACTLY quadratic:
+            # the minimizer is one data pass, not ~max_iter
+            # sequential Brent evaluations (the reference runs Brent
+            # even here, `GBMRegressor.scala:311,413` — same
+            # minimizer, found in closed form), clamped to Brent's
+            # [0, 100] bracket
+            res = y - pred
+            num = jnp.sum(bag_w * direction * res)
+            den = jnp.sum(bag_w * direction * direction)
+            if ax is not None:
+                num = jax.lax.psum(num, ax)
+                den = jax.lax.psum(den, ax)
+            alpha_opt = jnp.where(
+                den > 1e-30,
+                jnp.clip(num / jnp.maximum(den, 1e-30), 0.0, 100.0),
+                # zero direction: any weight is a no-op; keep 1.0
+                jnp.asarray(1.0, jnp.float32),
+            )
+        elif optimized:
+            def phi(a):
+                # bag-multiplicity weighting only (`GBMLoss.scala:50-74`)
+                v = jnp.sum(
+                    bag_w * loss.loss(y_enc, (pred + a * direction)[:, None])
+                )
+                return jax.lax.psum(v, ax) if ax is not None else v
+            alpha_opt = brent_minimize(
+                phi, 0.0, 100.0, tol=tol, max_iter=max_iter
+            )
+        else:
+            alpha_opt = jnp.asarray(1.0, jnp.float32)
+        # `scale` is the numeric guard's step damper (1.0 on the
+        # clean path — a multiplicative identity, bit-exact).  At
+        # scale == 0 (skip_round replay) the contribution is
+        # HARD-zeroed so a NaN direction/step cannot leak through
+        # 0 * NaN into the carried prediction state.
+        weight = jnp.where(scale > 0, lr * alpha_opt * scale, 0.0)
+        new_pred = pred + jnp.where(
+            scale > 0, weight * direction, 0.0
+        )
+        return params, weight, new_pred
+
+    return round_core
+
+
+def make_reg_chunk_fn(
+    base, loss_name, alpha_q, updates, optimized, goss, tol, max_iter,
+    huber, with_validation,
+):
+    """The UNJITTED single-chip chunk function: lax.scan of the round core
+    over a chunk of rounds (huber's adaptive delta and the validation loss
+    computed in-program, in the same per-round order as the host loop).
+    The sequential fit jits it directly; the megabatch sweep jits
+    ``vmap`` of it over a candidate axis — so sweep round math is the
+    sequential program by construction, not by parallel maintenance."""
+    round_core = make_reg_round_core(
+        base, loss_name, alpha_q, updates, optimized, goss, tol, max_iter
+    )
+
+    def chunk(ctx, X, y, w, valid_w, pred, pred_val, delta,
+              X_val_a, y_val_a, bag_ws, keys, masks, scales, lr):
+        def body(carry, xs):
+            pred, pred_val, delta = carry
+            bag_w, key, mask, scale = xs
+            if huber:
+                delta = weighted_quantile(
+                    jnp.abs(y - pred), alpha_q, weights=valid_w
+                )
+            params, weight, new_pred = round_core(
+                ctx, X, bag_w, key, mask, pred, delta, y, w, scale, lr
+            )
+            if with_validation:
+                dir_val = base.predict_fn(params, X_val_a)
+                # same hard-zero-at-scale-0 guard as the train-side
+                # update: 0 * NaN must not poison the val carry
+                new_pred_val = pred_val + jnp.where(
+                    scale > 0, weight * dir_val, 0.0
+                )
+                l = _make_reg_loss(loss_name, alpha_q, delta)
+                err = jnp.mean(
+                    l.loss(l.encode_label(y_val_a), new_pred_val[:, None])
+                )
+            else:
+                new_pred_val = pred_val
+                err = jnp.float32(0)
+            return (new_pred, new_pred_val, delta), (params, weight, err)
+
+        (pred, pred_val, delta), (params_all, weights_all, errs) = (
+            jax.lax.scan(
+                body, (pred, pred_val, delta),
+                (bag_ws, keys, masks, scales),
+            )
+        )
+        return params_all, weights_all, errs, pred, pred_val, delta
+
+    return chunk
+
+
+def make_cls_round_core(
+    base, loss, dim, updates, optimized, goss, tol, max_iter,
+    ax=None, member_size=1, dim_blk=None,
+):
+    """Classifier boosting round as a pure function; see
+    :func:`make_reg_round_core` for the traced-``lr`` contract (here the
+    step is ``lr * alpha_opt * scale`` over the class-dim vector)."""
+    dim_blk = dim if dim_blk is None else dim_blk
+    k_local = dim_blk // member_size
+
+    def round_core(ctx, X, y_enc, w, bag_w, key, mask, pred,
+                   alpha_ws, scale, lr):
+        labels, fit_w, bag_w = _pseudo_residuals_and_weights(
+            loss, updates, y_enc, pred, bag_w, w, axis_name=ax,
+            goss=goss, goss_key=jax.random.fold_in(key, 7),
+        )
+        if member_size > 1:
+            # each member shard fits its block of class dims — the
+            # SPMD replacement for the reference's per-dim Futures;
+            # phantom tail dims carry zero labels AND zero weights
+            if dim_blk != dim:
+                pad = [(0, 0), (0, dim_blk - dim)]
+                labels = jnp.pad(labels, pad)
+                fit_w = jnp.pad(fit_w, pad)
+            sl = jax.lax.axis_index("member") * k_local
+            labels_blk = jax.lax.dynamic_slice_in_dim(
+                labels, sl, k_local, axis=1
+            )
+            fitw_blk = jax.lax.dynamic_slice_in_dim(
+                fit_w, sl, k_local, axis=1
+            )
+        else:
+            labels_blk, fitw_blk = labels, fit_w
+        # one fused multi-member fit replaces the reference's
+        # per-dim Futures (trees: the class dims fold into a single
+        # histogram matmul per level — ops/tree.py fit_forest)
+        # fused fit + same-row predictions (leaf-id reuse for
+        # trees — the per-round forest predict re-route disappears)
+        params, directions = base.fit_many_and_directions(
+            ctx, labels_blk, fitw_blk, mask, key, X, axis_name=ax
+        )
+        if member_size > 1:
+            directions = jax.lax.all_gather(
+                directions, "member", axis=1, tiled=True
+            )[:, :dim]
+        if optimized:
+            # SHARD-LOCAL objective; projected_newton_box psums
+            # value/grad/hessian over `ax` itself (psum inside the
+            # objective would break its autodiff — see linesearch.py)
+            def phi(a):
+                return jnp.sum(
+                    bag_w * loss.loss(y_enc, pred + a[None, :] * directions)
+                )
+
+            # one-pass closed-form grad/hessian (ops/losses.py)
+            # instead of dim forward passes of jax.hessian per
+            # Newton iteration — the dominant round cost at K=26
+            if loss.has_hessian:
+                gh = lambda a: loss.linesearch_grad_hess(
+                    y_enc, pred + a[None, :] * directions, directions, bag_w
+                )
+            else:
+                gh = None
+            # warm start from the previous round's converged step
+            # sizes (carried through the scan): consecutive rounds'
+            # objectives are near-identical, so Newton typically
+            # re-converges in 1-2 iterations instead of ~5 from
+            # all-ones — the line-search small-op tail is a
+            # measured slice of the device round (BASELINE.md)
+            alpha_opt = projected_newton_box(
+                phi,
+                alpha_ws,
+                max_iter=min(max_iter, 25),
+                tol=tol,
+                axis_name=ax,
+                grad_hess=gh,
+            )
+        else:
+            alpha_opt = jnp.ones((dim,), jnp.float32)
+        # `scale` is the numeric guard's step damper (1.0 on the
+        # clean path — multiplicative identity).  At scale == 0 the
+        # contribution is HARD-zeroed (0 * NaN must not leak), and
+        # the warm-start carry resets to ones when the line search
+        # itself went non-finite so later rounds restart clean.
+        weight = jnp.where(
+            scale > 0, lr * alpha_opt * scale, 0.0
+        )
+        new_pred = pred + jnp.where(
+            scale > 0, weight[None, :] * directions, 0.0
+        )
+        alpha_carry = jnp.where(
+            jnp.isfinite(alpha_opt), alpha_opt,
+            jnp.ones_like(alpha_opt),
+        )
+        return params, weight, new_pred, alpha_carry
+
+    return round_core
+
+
+def make_cls_chunk_fn(
+    base, loss, dim, updates, optimized, goss, tol, max_iter,
+    with_validation,
+):
+    """UNJITTED single-chip classifier chunk (see :func:`make_reg_chunk_fn`
+    for the sequential/megabatch single-source contract)."""
+    round_core = make_cls_round_core(
+        base, loss, dim, updates, optimized, goss, tol, max_iter
+    )
+
+    def chunk(ctx, X, y_enc, w, pred, pred_val, alpha_ws, X_val_a,
+              y_enc_val_a, bag_ws, keys, masks, scales, lr):
+        def body(carry, xs):
+            pred, pred_val, alpha_ws = carry
+            bag_w, key, mask, scale = xs
+            params, weight, new_pred, alpha_ws = round_core(
+                ctx, X, y_enc, w, bag_w, key, mask, pred, alpha_ws,
+                scale, lr,
+            )
+            if with_validation:
+                dirs_val = jax.vmap(
+                    lambda p: base.predict_fn(p, X_val_a)
+                )(params).T
+                new_pred_val = pred_val + jnp.where(
+                    scale > 0, weight[None, :] * dirs_val, 0.0
+                )
+                err = jnp.mean(loss.loss(y_enc_val_a, new_pred_val))
+            else:
+                new_pred_val = pred_val
+                err = jnp.float32(0)
+            return (new_pred, new_pred_val, alpha_ws), (params, weight, err)
+
+        (pred, pred_val, alpha_ws), (params_all, weights_all, errs) = (
+            jax.lax.scan(
+                body, (pred, pred_val, alpha_ws),
+                (bag_ws, keys, masks, scales),
+            )
+        )
+        return params_all, weights_all, errs, pred, pred_val, alpha_ws
+
+    return chunk
+
+
 def _probe_classifier_phases(
     telem, loss, updates, base, ctx, X, y_enc, w, bag_w, key, mask, pred,
     alpha_ws, optimized, lr, tol, max_iter, goss,
@@ -886,125 +1166,17 @@ class GBMRegressor(_GBMParams):
         loss_name = self.loss.lower()
         base_key = base.config_key()
 
-        def make_loss(delta):
-            # local snapshot of _make_loss: cached closures must not read
-            # `self` at (re)trace time — set_params after fit would corrupt
-            # a retrace under the original cache key
-            if loss_name == "huber":
-                return losses_mod.HuberLoss(delta)
-            return losses_mod.get_regression_loss(
-                loss_name, alpha=alpha_q, quantile=alpha_q
-            )
-
         with_validation = X_val is not None
 
         # all data flows through arguments so the jitted programs are
-        # reusable across fits with the same config (no per-fit retrace)
-        def make_round_core():
-            def round_core(ctx, X, bag_w, key, mask, pred, delta, y, w,
-                           scale):
-                loss = make_loss(delta)
-                y_enc = loss.encode_label(y)
-                labels, fit_w, bag_w = _pseudo_residuals_and_weights(
-                    loss, updates, y_enc, pred[:, None], bag_w, w,
-                    axis_name=ax, goss=goss,
-                    goss_key=jax.random.fold_in(key, 7),
-                )
-                # fit + same-row predictions in one protocol call: tree
-                # learners reuse the leaf ids their fit computed instead
-                # of re-routing every row (models/tree.py)
-                params, direction = base.fit_and_direction(
-                    ctx, labels[:, 0], fit_w[:, 0], mask, key, X,
-                    axis_name=ax,
-                )
-                if optimized and loss_name == "squared":
-                    # phi(a) = sum bw*(res - a*dir)^2/2 is EXACTLY quadratic:
-                    # the minimizer is one data pass, not ~max_iter
-                    # sequential Brent evaluations (the reference runs Brent
-                    # even here, `GBMRegressor.scala:311,413` — same
-                    # minimizer, found in closed form), clamped to Brent's
-                    # [0, 100] bracket
-                    res = y - pred
-                    num = jnp.sum(bag_w * direction * res)
-                    den = jnp.sum(bag_w * direction * direction)
-                    if ax is not None:
-                        num = jax.lax.psum(num, ax)
-                        den = jax.lax.psum(den, ax)
-                    alpha_opt = jnp.where(
-                        den > 1e-30,
-                        jnp.clip(num / jnp.maximum(den, 1e-30), 0.0, 100.0),
-                        # zero direction: any weight is a no-op; keep 1.0
-                        jnp.asarray(1.0, jnp.float32),
-                    )
-                elif optimized:
-                    def phi(a):
-                        # bag-multiplicity weighting only (`GBMLoss.scala:50-74`)
-                        v = jnp.sum(
-                            bag_w * loss.loss(y_enc, (pred + a * direction)[:, None])
-                        )
-                        return jax.lax.psum(v, ax) if ax is not None else v
-                    alpha_opt = brent_minimize(
-                        phi, 0.0, 100.0, tol=tol, max_iter=max_iter
-                    )
-                else:
-                    alpha_opt = jnp.asarray(1.0, jnp.float32)
-                # `scale` is the numeric guard's step damper (1.0 on the
-                # clean path — a multiplicative identity, bit-exact).  At
-                # scale == 0 (skip_round replay) the contribution is
-                # HARD-zeroed so a NaN direction/step cannot leak through
-                # 0 * NaN into the carried prediction state.
-                weight = jnp.where(scale > 0, lr * alpha_opt * scale, 0.0)
-                new_pred = pred + jnp.where(
-                    scale > 0, weight * direction, 0.0
-                )
-                return params, weight, new_pred
-
-            return round_core
-
+        # reusable across fits with the same config (no per-fit retrace);
+        # round math lives in the module-level factories shared with the
+        # megabatch sweep (models/gbm_sweep.py)
         def build_chunk_step():
-            """lax.scan of round_core over a chunk of rounds (one dispatch
-            per chunk; huber's adaptive delta and the validation loss are
-            computed in-program, in the same per-round order as the host
-            loop)."""
-            round_core = make_round_core()
-
-            def chunk(ctx, X, y, w, valid_w, pred, pred_val, delta,
-                      X_val_a, y_val_a, bag_ws, keys, masks, scales):
-                def body(carry, xs):
-                    pred, pred_val, delta = carry
-                    bag_w, key, mask, scale = xs
-                    if huber:
-                        delta = weighted_quantile(
-                            jnp.abs(y - pred), alpha_q, weights=valid_w
-                        )
-                    params, weight, new_pred = round_core(
-                        ctx, X, bag_w, key, mask, pred, delta, y, w, scale
-                    )
-                    if with_validation:
-                        dir_val = base.predict_fn(params, X_val_a)
-                        # same hard-zero-at-scale-0 guard as the train-side
-                        # update: 0 * NaN must not poison the val carry
-                        new_pred_val = pred_val + jnp.where(
-                            scale > 0, weight * dir_val, 0.0
-                        )
-                        l = make_loss(delta)
-                        err = jnp.mean(
-                            l.loss(l.encode_label(y_val_a), new_pred_val[:, None])
-                        )
-                    else:
-                        new_pred_val = pred_val
-                        err = jnp.float32(0)
-                    return (new_pred, new_pred_val, delta), (params, weight, err)
-
-                (pred, pred_val, delta), (params_all, weights_all, errs) = (
-                    jax.lax.scan(
-                        body, (pred, pred_val, delta),
-                        (bag_ws, keys, masks, scales),
-                    )
-                )
-                return params_all, weights_all, errs, pred, pred_val, delta
-
-            return jax.jit(chunk)
+            return jax.jit(make_reg_chunk_fn(
+                base, loss_name, alpha_q, updates, optimized, goss, tol,
+                max_iter, huber, with_validation,
+            ))
 
         def build_chunk_step_mesh():
             """Scan-chunked rounds as ONE shard_map-ed SPMD program — the
@@ -1014,11 +1186,14 @@ class GBMRegressor(_GBMParams):
             is a psum-ed weighted mean over the valid (non-padding) val rows
             — the reference evaluates validation loss distributed per round
             the same way (`GBMRegressor.scala:444-465`)."""
-            round_core = make_round_core()
+            round_core = make_reg_round_core(
+                base, loss_name, alpha_q, updates, optimized, goss, tol,
+                max_iter, ax=ax,
+            )
 
             def chunk(ctx, X, y, w, valid_w, pred, pred_val, delta,
                       X_val_a, y_val_a, valid_val, bag_ws, keys, masks,
-                      scales):
+                      scales, lr):
                 def body(carry, xs):
                     pred, pred_val, delta = carry
                     bag_w, key, mask, scale = xs
@@ -1031,14 +1206,15 @@ class GBMRegressor(_GBMParams):
                             axis_name=ax,
                         )
                     params, weight, new_pred = round_core(
-                        ctx, X, bag_w, key, mask, pred, delta, y, w, scale
+                        ctx, X, bag_w, key, mask, pred, delta, y, w, scale,
+                        lr,
                     )
                     if with_validation:
                         dir_val = base.predict_fn(params, X_val_a)
                         new_pred_val = pred_val + jnp.where(
                             scale > 0, weight * dir_val, 0.0
                         )
-                        l = make_loss(delta)
+                        l = _make_reg_loss(loss_name, alpha_q, delta)
                         le = l.loss(
                             l.encode_label(y_val_a), new_pred_val[:, None]
                         )
@@ -1080,19 +1256,22 @@ class GBMRegressor(_GBMParams):
                         P(),  # keys [c, 2]
                         P(),  # masks [c, d]
                         P(),  # scales [c]
+                        P(),  # lr
                     ),
                     out_specs=(P(), P(), P(), P(ax), P(ax), P()),
                     check_vma=False,
                 )
             )
 
+        # NOTE: learning_rate is deliberately ABSENT — it enters the chunk
+        # programs as a traced argument, so fits differing only in lr share
+        # one compiled program (and the megabatch sweep batches over it)
         round_key = (
             "gbm_reg_round",
             loss_name,
             alpha_q,
             updates,
             optimized,
-            lr,
             goss,
             sub_ratio,
             repl,
@@ -1117,8 +1296,10 @@ class GBMRegressor(_GBMParams):
             ("gbm_reg_eval", loss_name, alpha_q),
             lambda: jax.jit(
                 lambda pred_v, delta, y_v: jnp.mean(
-                    make_loss(delta).loss(
-                        make_loss(delta).encode_label(y_v), pred_v[:, None]
+                    _make_reg_loss(loss_name, alpha_q, delta).loss(
+                        _make_reg_loss(loss_name, alpha_q, delta)
+                        .encode_label(y_v),
+                        pred_v[:, None],
                     )
                 )
             ),
@@ -1223,7 +1404,7 @@ class GBMRegressor(_GBMParams):
                         y_val if with_validation else val_dummy,
                         valid_val,
                         bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
-                        scales,
+                        scales, jnp.float32(lr),
                     )
                 )
             else:
@@ -1235,7 +1416,7 @@ class GBMRegressor(_GBMParams):
                         X_val if with_validation else val_dummy,
                         y_val if with_validation else val_dummy,
                         bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
-                        scales,
+                        scales, jnp.float32(lr),
                     )
                 )
             if with_validation:
@@ -1486,6 +1667,33 @@ class GBMClassifier(_GBMParams):
     def _make_loss(self, num_classes):
         return losses_mod.get_classification_loss(self.loss.lower(), num_classes)
 
+    def _init_raw_scores(self, X, y, w, num_classes, dim, mesh=None):
+        """Init model + round-0 raw scores (`GBMClassifier.scala:275-288`);
+        ``num_classes`` is passed explicitly — the train split may be
+        missing the top class (validation indicator or CV fold), and the
+        init prior must still be K-dimensional.  Shared by ``fit`` and the
+        megabatch sweep (models/gbm_sweep.py) so the two paths can never
+        diverge on round-0 state."""
+        init_dummy = DummyClassifier(strategy=self.init_strategy)
+        init_model = init_dummy.fit(
+            X, y, sample_weight=w, num_classes=num_classes,
+            **mesh_fit_kwargs(init_dummy, mesh),
+        )
+        if dim == 1 and num_classes == 2 and self.init_strategy.lower() == "prior":
+            # clamp BOTH sides: with explicit num_classes a train split can
+            # contain zero positives (p1 == 0), and log(0) = -inf would
+            # poison every raw prediction
+            p1 = init_model.params["proba"][1]
+            logodds = jnp.log(
+                jnp.maximum(p1, 1e-30) / jnp.maximum(1.0 - p1, 1e-30)
+            )
+            init_raw = logodds[None]
+        elif dim == 1:
+            init_raw = jnp.zeros((1,), jnp.float32)
+        else:
+            init_raw = init_model.params["raw"]
+        return init_model, init_raw
+
     @instrumented_fit
     def fit(
         self,
@@ -1548,28 +1756,9 @@ class GBMClassifier(_GBMParams):
         # per-dim Futures (`GBMClassifier.scala:377-411`)
         dim_blk = dim + (-dim) % member_size
 
-        # init raw scores (`GBMClassifier.scala:275-288`); num_classes is
-        # passed explicitly — the train split may be missing the top class
-        # (validation indicator or CV fold), and the init prior must still
-        # be K-dimensional
-        init_dummy = DummyClassifier(strategy=self.init_strategy)
-        init_model = init_dummy.fit(
-            X, y, sample_weight=w, num_classes=num_classes,
-            **mesh_fit_kwargs(init_dummy, mesh),
+        init_model, init_raw = self._init_raw_scores(
+            X, y, w, num_classes, dim, mesh=mesh
         )
-        if dim == 1 and num_classes == 2 and self.init_strategy.lower() == "prior":
-            # clamp BOTH sides: with explicit num_classes a train split can
-            # contain zero positives (p1 == 0), and log(0) = -inf would
-            # poison every raw prediction
-            p1 = init_model.params["proba"][1]
-            logodds = jnp.log(
-                jnp.maximum(p1, 1e-30) / jnp.maximum(1.0 - p1, 1e-30)
-            )
-            init_raw = logodds[None]
-        elif dim == 1:
-            init_raw = jnp.zeros((1,), jnp.float32)
-        else:
-            init_raw = init_model.params["raw"]
 
         updates = self.updates.lower()
         optimized = bool(self.optimized_weights)
@@ -1605,135 +1794,13 @@ class GBMClassifier(_GBMParams):
         if with_validation:
             y_enc_val = loss.encode_label(y_val)
 
-        def make_round_core():
-            k_local = dim_blk // member_size
-
-            def round_core(ctx, X, y_enc, w, bag_w, key, mask, pred,
-                           alpha_ws, scale):
-                labels, fit_w, bag_w = _pseudo_residuals_and_weights(
-                    loss, updates, y_enc, pred, bag_w, w, axis_name=ax,
-                    goss=goss, goss_key=jax.random.fold_in(key, 7),
-                )
-                if member_size > 1:
-                    # each member shard fits its block of class dims — the
-                    # SPMD replacement for the reference's per-dim Futures;
-                    # phantom tail dims carry zero labels AND zero weights
-                    if dim_blk != dim:
-                        pad = [(0, 0), (0, dim_blk - dim)]
-                        labels = jnp.pad(labels, pad)
-                        fit_w = jnp.pad(fit_w, pad)
-                    sl = jax.lax.axis_index("member") * k_local
-                    labels_blk = jax.lax.dynamic_slice_in_dim(
-                        labels, sl, k_local, axis=1
-                    )
-                    fitw_blk = jax.lax.dynamic_slice_in_dim(
-                        fit_w, sl, k_local, axis=1
-                    )
-                else:
-                    labels_blk, fitw_blk = labels, fit_w
-                # one fused multi-member fit replaces the reference's
-                # per-dim Futures (trees: the class dims fold into a single
-                # histogram matmul per level — ops/tree.py fit_forest)
-                # fused fit + same-row predictions (leaf-id reuse for
-                # trees — the per-round forest predict re-route disappears)
-                params, directions = base.fit_many_and_directions(
-                    ctx, labels_blk, fitw_blk, mask, key, X, axis_name=ax
-                )
-                if member_size > 1:
-                    directions = jax.lax.all_gather(
-                        directions, "member", axis=1, tiled=True
-                    )[:, :dim]
-                if optimized:
-                    # SHARD-LOCAL objective; projected_newton_box psums
-                    # value/grad/hessian over `ax` itself (psum inside the
-                    # objective would break its autodiff — see linesearch.py)
-                    def phi(a):
-                        return jnp.sum(
-                            bag_w * loss.loss(y_enc, pred + a[None, :] * directions)
-                        )
-
-                    # one-pass closed-form grad/hessian (ops/losses.py)
-                    # instead of dim forward passes of jax.hessian per
-                    # Newton iteration — the dominant round cost at K=26
-                    if loss.has_hessian:
-                        gh = lambda a: loss.linesearch_grad_hess(
-                            y_enc, pred + a[None, :] * directions, directions, bag_w
-                        )
-                    else:
-                        gh = None
-                    # warm start from the previous round's converged step
-                    # sizes (carried through the scan): consecutive rounds'
-                    # objectives are near-identical, so Newton typically
-                    # re-converges in 1-2 iterations instead of ~5 from
-                    # all-ones — the line-search small-op tail is a
-                    # measured slice of the device round (BASELINE.md)
-                    alpha_opt = projected_newton_box(
-                        phi,
-                        alpha_ws,
-                        max_iter=min(max_iter, 25),
-                        tol=tol,
-                        axis_name=ax,
-                        grad_hess=gh,
-                    )
-                else:
-                    alpha_opt = jnp.ones((dim,), jnp.float32)
-                # `scale` is the numeric guard's step damper (1.0 on the
-                # clean path — multiplicative identity).  At scale == 0 the
-                # contribution is HARD-zeroed (0 * NaN must not leak), and
-                # the warm-start carry resets to ones when the line search
-                # itself went non-finite so later rounds restart clean.
-                weight = jnp.where(
-                    scale > 0, lr * alpha_opt * scale, 0.0
-                )
-                new_pred = pred + jnp.where(
-                    scale > 0, weight[None, :] * directions, 0.0
-                )
-                alpha_carry = jnp.where(
-                    jnp.isfinite(alpha_opt), alpha_opt,
-                    jnp.ones_like(alpha_opt),
-                )
-                return params, weight, new_pred, alpha_carry
-
-            return round_core
-
+        # round math lives in the module-level factories shared with the
+        # megabatch sweep (models/gbm_sweep.py); see make_cls_round_core
         def build_chunk_step():
-            """lax.scan of round_core over a chunk of rounds — ONE dispatch
-            and one XLA program per chunk instead of per round (validation
-            losses computed in-program, early-stop applied on the host after
-            the chunk; round math identical to the per-round path)."""
-            round_core = make_round_core()
-
-            def chunk(ctx, X, y_enc, w, pred, pred_val, alpha_ws, X_val_a,
-                      y_enc_val_a, bag_ws, keys, masks, scales):
-                def body(carry, xs):
-                    pred, pred_val, alpha_ws = carry
-                    bag_w, key, mask, scale = xs
-                    params, weight, new_pred, alpha_ws = round_core(
-                        ctx, X, y_enc, w, bag_w, key, mask, pred, alpha_ws,
-                        scale,
-                    )
-                    if with_validation:
-                        dirs_val = jax.vmap(
-                            lambda p: base.predict_fn(p, X_val_a)
-                        )(params).T
-                        new_pred_val = pred_val + jnp.where(
-                            scale > 0, weight[None, :] * dirs_val, 0.0
-                        )
-                        err = jnp.mean(loss.loss(y_enc_val_a, new_pred_val))
-                    else:
-                        new_pred_val = pred_val
-                        err = jnp.float32(0)
-                    return (new_pred, new_pred_val, alpha_ws), (params, weight, err)
-
-                (pred, pred_val, alpha_ws), (params_all, weights_all, errs) = (
-                    jax.lax.scan(
-                        body, (pred, pred_val, alpha_ws),
-                        (bag_ws, keys, masks, scales),
-                    )
-                )
-                return params_all, weights_all, errs, pred, pred_val, alpha_ws
-
-            return jax.jit(chunk)
+            return jax.jit(make_cls_chunk_fn(
+                base, loss, dim, updates, optimized, goss, tol, max_iter,
+                with_validation,
+            ))
 
         def build_chunk_step_mesh():
             """Scan-chunked rounds as ONE shard_map-ed SPMD program (see
@@ -1743,16 +1810,20 @@ class GBMClassifier(_GBMParams):
             shard's class-dim directions all_gather-ed before the update —
             the reference's distributed per-round validation evaluation
             (`GBMRegressor.scala:444-465`)."""
-            round_core = make_round_core()
+            round_core = make_cls_round_core(
+                base, loss, dim, updates, optimized, goss, tol, max_iter,
+                ax=ax, member_size=member_size, dim_blk=dim_blk,
+            )
 
             def chunk(ctx, X, y_enc, w, pred, pred_val, alpha_ws, X_val_a,
-                      y_enc_val_a, valid_val, bag_ws, keys, masks, scales):
+                      y_enc_val_a, valid_val, bag_ws, keys, masks, scales,
+                      lr):
                 def body(carry, xs):
                     pred, pred_val, alpha_ws = carry
                     bag_w, key, mask, scale = xs
                     params, weight, new_pred, alpha_ws = round_core(
                         ctx, X, y_enc, w, bag_w, key, mask, pred, alpha_ws,
-                        scale,
+                        scale, lr,
                     )
                     if with_validation:
                         dirs_val = jax.vmap(
@@ -1803,6 +1874,7 @@ class GBMClassifier(_GBMParams):
                         P(),  # keys [c, 2]
                         P(),  # masks [c, d]
                         P(),  # scales [c]
+                        P(),  # lr
                     ),
                     out_specs=(
                         P(None, "member") if member_size > 1 else P(),
@@ -1816,13 +1888,14 @@ class GBMClassifier(_GBMParams):
                 )
             )
 
+        # learning_rate is a traced chunk argument, not part of the program
+        # identity (see the regressor's round_key note)
         round_key = (
             "gbm_cls_round",
             loss_name,
             num_classes,
             updates,
             optimized,
-            lr,
             goss,
             sub_ratio,
             repl,
@@ -1952,7 +2025,7 @@ class GBMClassifier(_GBMParams):
                         y_enc_val if with_validation else val_dummy2,
                         valid_val,
                         bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
-                        scales,
+                        scales, jnp.float32(lr),
                     )
                 )
                 if dim_blk != dim:
@@ -1970,7 +2043,7 @@ class GBMClassifier(_GBMParams):
                         X_val if with_validation else val_dummy,
                         y_enc_val if with_validation else val_dummy,
                         bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
-                        scales,
+                        scales, jnp.float32(lr),
                     )
                 )
             if with_validation:

@@ -1,0 +1,646 @@
+"""Megabatch sweep engine: train a whole hyperparameter sweep as ONE
+batched XLA dispatch per round chunk (docs/selection.md#megabatch-sweeps).
+
+The tuning loop (`tuning.py`) fits ``num_maps x num_folds`` candidates that
+share the binned feature matrix and differ only in per-candidate
+hyperparameter ARRAYS — learning rate, sampling seed, subsample/subspace
+draws — plus the fold's zero-weight mask (weight-mask folds keep every
+candidate's ``X`` identical, see tuning.py).  That is exactly the shape
+``jax.vmap`` wants: this module jits ``vmap(chunk_fn)`` over a new leading
+config axis, where ``chunk_fn`` is the SAME unjitted scan-chunked round
+function the sequential fit jits (``models/gbm.py``
+``make_reg_chunk_fn``/``make_cls_chunk_fn``).  Sweep round math is the
+sequential program by construction; results are pinned bit-identical
+(tests/test_megabatch.py).
+
+Precedent: GPU tree boosting wins by saturating the accelerator with
+batched independent work (arXiv 1806.11248) and pipelined grad/hist
+dataflow (arXiv 2011.02022); here the batch is the candidate axis.
+
+Per-dispatch batching is keyed on the ``configs_per_dispatch`` tunable
+(autotune/space.py): candidates are packed into slabs of at most that many
+lanes, the last slab padded by replicating its first lane (padded lanes are
+computed and discarded — vmap lanes are independent).  Program count is
+O(distinct chunk shapes), never O(candidates).
+
+With a validation split, per-round validation losses come back ``[S, c]``
+and the host applies the reference patience rule per candidate; candidates
+that stop early get their remaining rounds hard-zeroed via the existing
+``scale`` damper (the numeric guard's mechanism — successive halving for
+free), and their trailing members are trimmed by the same ``keep = i - v``
+absolute-round-index contract the sequential fit uses.
+
+Under a ``mesh`` the CONFIG axis is sharded over the mesh's "member" axis
+(rows stay whole per lane, so per-lane reductions are single-device and
+values match the unsharded lanes); the data/member row sharding of
+``fit(..., mesh=...)`` stays with the sequential path.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from spark_ensemble_tpu.autotune import resolve as _tuned
+from spark_ensemble_tpu.models.base import (
+    as_f32,
+    cached_program,
+    infer_num_classes,
+    make_shared_fit_ctx,
+    resolve_weights,
+    resolved_scan_chunk,
+)
+from spark_ensemble_tpu.models.gbm import (
+    GBMClassificationModel,
+    GBMClassifier,
+    GBMRegressionModel,
+    GBMRegressor,
+    _make_reg_loss,
+    make_cls_chunk_fn,
+    make_reg_chunk_fn,
+    slice_pytree,
+)
+from spark_ensemble_tpu.telemetry.events import FitTelemetry
+from spark_ensemble_tpu.telemetry.quality import drift_reference_from_ctx
+from spark_ensemble_tpu.utils.quantile import weighted_quantile
+
+logger = logging.getLogger(__name__)
+
+#: live literal behind the ``configs_per_dispatch`` tunable
+#: (autotune/space.py mirrors this default — keep them in sync)
+_CONFIGS_PER_DISPATCH = 32
+
+#: params that may differ WITHIN one batched sweep group: they enter the
+#: compiled program as traced arrays (learning_rate) or as data the host
+#: feeds it (seed/subsample/subspace draws), or stay host-side entirely
+#: (round counts, patience bookkeeping)
+SWEEP_BATCHED_PARAMS = (
+    "learning_rate",
+    "seed",
+    "subsample_ratio",
+    "subspace_ratio",
+    "num_base_learners",
+    "num_rounds",
+    "validation_tol",
+)
+
+# vmap in_axes over the chunk-fn signatures (models/gbm.py):
+#   reg: (ctx, X, y, w, valid_w, pred, pred_val, delta, X_val, y_val,
+#         bag_ws, keys, masks, scales, lr)
+#   cls: (ctx, X, y_enc, w, pred, pred_val, alpha_ws, X_val, y_enc_val,
+#         bag_ws, keys, masks, scales, lr)
+# shared data (ctx/X/targets/validation split) broadcasts; everything a
+# candidate owns — weights, prediction carries, sampling draws, lr — maps
+# over the leading config axis.
+_REG_IN_AXES = (None, None, None, 0, None, 0, 0, 0, None, None,
+                0, 0, 0, 0, 0)
+_CLS_IN_AXES = (None, None, None, 0, 0, 0, 0, None, None,
+                0, 0, 0, 0, 0)
+
+
+def sweep_group_key(estimator) -> tuple:
+    """Structural fingerprint of a candidate: its ``config_key`` with every
+    batchable param pinned to a sentinel value.  Candidates with equal
+    group keys trace to the SAME vmapped program and may share one
+    megabatch; a tuning grid that also sweeps structural params (loss,
+    depth, base learner, ...) is partitioned into one batch per group."""
+    return estimator.copy(
+        learning_rate=1.0,
+        seed=0,
+        subsample_ratio=1.0,
+        subspace_ratio=1.0,
+        num_base_learners=1,
+        num_rounds=1,
+        validation_tol=0.01,
+    ).config_key()
+
+
+def sweep_unsupported_reason(estimator, mesh=None) -> Optional[str]:
+    """Why this estimator cannot ride the megabatch path (None = it can).
+    ``tuning.py`` falls back to the sequential loop on a reason under
+    ``megabatch="auto"`` and raises it under ``megabatch="on"``."""
+    if not isinstance(estimator, (GBMRegressor, GBMClassifier)):
+        return (
+            f"{type(estimator).__name__} has no megabatch sweep support "
+            "(GBMRegressor/GBMClassifier only)"
+        )
+    if estimator.checkpoint_dir:
+        return "checkpoint_dir is set (sweep candidates are not checkpointable)"
+    if estimator.profile_dir:
+        return "profile_dir is set (per-candidate profiling needs sequential fits)"
+    if estimator.on_nonfinite not in ("raise", "off"):
+        return (
+            f"on_nonfinite={estimator.on_nonfinite!r} needs the sequential "
+            "recovery driver (sweeps support 'raise'/'off' only)"
+        )
+    return None
+
+
+def _pad_rounds(a, max_m: int):
+    """Pad a per-candidate round-indexed array to the sweep's max round
+    count by repeating the last row; padded rounds run at scale 0 and are
+    trimmed, so the values never reach a kept member."""
+    if a.shape[0] == max_m:
+        return a
+    reps = jnp.broadcast_to(a[-1:], (max_m - a.shape[0],) + a.shape[1:])
+    return jnp.concatenate([a, reps], axis=0)
+
+
+def _concat_rounds(chunks: List[Any]):
+    """Concatenate [S, c, ...] chunk pytrees along the ROUND axis (axis 1;
+    axis 0 is the config axis)."""
+    if len(chunks) == 1:
+        return chunks[0]
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs, axis=1), *chunks
+    )
+
+
+def _config_sharder(mesh, slab: int):
+    """device_put callback sharding the leading config axis over the mesh's
+    "member" axis (None when the mesh cannot hold it).  Rows stay whole per
+    lane — each candidate's reductions run on one device, so lane values
+    match the unsharded program."""
+    if mesh is None or "member" not in getattr(mesh, "axis_names", ()):
+        return None
+    member_size = int(np.prod([
+        mesh.shape[a] for a in mesh.axis_names if a == "member"
+    ]))
+    if member_size <= 1 or slab % member_size != 0:
+        return None
+
+    def put(tree):
+        def one(x):
+            x = jnp.asarray(x)
+            spec = P(*(("member",) + (None,) * (x.ndim - 1)))
+            return jax.device_put(x, NamedSharding(mesh, spec))
+
+        return jax.tree_util.tree_map(one, tree)
+
+    return put
+
+
+def _drive_sweep_slab(
+    dispatch,
+    lanes_m: List[int],
+    max_m: int,
+    chunk: int,
+    with_validation: bool,
+    best0: List[float],
+    patience: List[int],
+    val_tols: List[float],
+    patience_step,
+    guard=None,
+    telem: Optional[FitTelemetry] = None,
+):
+    """Lockstep round loop for one slab of candidates: one batched dispatch
+    per round chunk, host patience per lane, ``scale = 0`` masking for
+    lanes that stopped (successive halving — losers stop consuming the
+    dispatch's useful lanes while keys/masks stay aligned to absolute round
+    indices).  Returns (members_chunks, weights_chunks, i, v, best,
+    val_hists)."""
+    S = len(lanes_m)
+    members_chunks: List[Any] = []
+    weights_chunks: List[Any] = []
+    i = [0] * S
+    v = [0] * S
+    best = list(best0)
+    stopped = [False] * S
+    val_hists: List[List[float]] = [[] for _ in range(S)]
+    r0 = 0
+    while r0 < max_m and any(
+        not stopped[s] and lanes_m[s] > r0 for s in range(S)
+    ):
+        c = min(chunk, max_m - r0)
+        scales = np.ones((S, c), np.float32)
+        for s in range(S):
+            for j in range(c):
+                if stopped[s] or r0 + j >= lanes_m[s]:
+                    scales[s, j] = 0.0
+        active = int(scales.sum())
+        t0 = time.perf_counter()
+        params_c, weights_c, errs = dispatch(r0, c, jnp.asarray(scales))
+        if guard is not None and guard.active:
+            # one fused finiteness reduction over the whole [S, c] chunk —
+            # same detection cadence as the sequential driver; the only
+            # supported policy here is fail-fast (see
+            # sweep_unsupported_reason)
+            strict = (weights_c, errs) if with_validation else (weights_c,)
+            if guard.first_nonfinite(params_c, *strict) is not None:
+                guard.raise_error(r0, what="sweep chunk outputs")
+        members_chunks.append(params_c)
+        weights_chunks.append(weights_c)
+        if with_validation:
+            errs_np = np.asarray(errs)
+            for s in range(S):
+                if stopped[s] or lanes_m[s] <= r0:
+                    continue
+                lane_stop = False
+                for j in range(min(c, lanes_m[s] - r0)):
+                    err = float(errs_np[s, j])
+                    val_hists[s].append(err)
+                    best[s], v[s] = patience_step(
+                        best[s], err, v[s], val_tols[s]
+                    )
+                    if v[s] >= patience[s]:
+                        i[s] = r0 + j + 1
+                        stopped[s] = True
+                        lane_stop = True
+                        break
+                if not lane_stop:
+                    i[s] = min(lanes_m[s], r0 + c)
+        else:
+            for s in range(S):
+                i[s] = min(lanes_m[s], r0 + c)
+        if telem is not None and telem.enabled:
+            # fence on the chunk outputs before reading the clock, then
+            # attribute the dispatch's wall to its live lanes — the
+            # per-candidate round ledger for sweeps
+            telem.blocking_read((params_c, weights_c, errs))
+            wall = time.perf_counter() - t0
+            telem.emit(
+                "sweep_chunk",
+                start_round=r0,
+                rounds=c,
+                candidates=S,
+                active_lane_rounds=active,
+                wall_s=wall,
+                per_candidate_round_s=wall / max(1, active),
+            )
+        r0 += c
+    return members_chunks, weights_chunks, i, v, best, val_hists
+
+
+def fit_sweep(
+    estimators: Sequence[Any],
+    X,
+    y,
+    sample_weights: Optional[Sequence[Any]] = None,
+    num_classes: Optional[int] = None,
+    validation_indicator=None,
+    mesh=None,
+    telemetry_path: Optional[str] = None,
+) -> List[Any]:
+    """Fit every candidate estimator on the SAME feature matrix as one
+    batched program per round chunk; returns fitted models in candidate
+    order, each bit-identical to ``estimators[b].fit(X, y,
+    sample_weight=sample_weights[b], ...)`` on a single device.
+
+    Candidates must share every structural param (``sweep_group_key``);
+    they may differ in ``SWEEP_BATCHED_PARAMS``.  ``sample_weights`` is one
+    weight vector per candidate (tuning's zero-weight fold masks), or None
+    for unit weights everywhere."""
+    ests = list(estimators)
+    if not ests:
+        return []
+    est0 = ests[0]
+    reason = sweep_unsupported_reason(est0, mesh)
+    if reason is not None:
+        raise ValueError(f"fit_sweep: {reason}")
+    gk = sweep_group_key(est0)
+    for est in ests[1:]:
+        if sweep_group_key(est) != gk:
+            raise ValueError(
+                "fit_sweep candidates must share every structural param; "
+                "only " + ", ".join(SWEEP_BATCHED_PARAMS) + " may differ "
+                "within one batch (group structurally-distinct candidates "
+                "with sweep_group_key)"
+            )
+    B = len(ests)
+    X = as_f32(X)
+    y = as_f32(y)
+    est0._validate_fit_inputs(X, y)
+    if sample_weights is None:
+        sample_weights = [None] * B
+    if len(sample_weights) != B:
+        raise ValueError(
+            f"sample_weights must have one entry per candidate "
+            f"({B}); got {len(sample_weights)}"
+        )
+    w_full = [resolve_weights(y, sw) for sw in sample_weights]
+    if validation_indicator is not None:
+        vi = np.asarray(validation_indicator, bool)
+        X_val, y_val = X[vi], y[vi]
+        Xt, yt = X[~vi], y[~vi]
+        w_list = [wb[~vi] for wb in w_full]
+    else:
+        X_val = y_val = None
+        Xt, yt = X, y
+        w_list = w_full
+    n, d = Xt.shape
+    with_validation = X_val is not None
+
+    telem = FitTelemetry.start(
+        est0, family=f"GBMSweep[{type(est0).__name__}]", n=n, d=d,
+        telemetry_path=telemetry_path, candidates=B,
+    )
+    try:
+        models = _fit_sweep_inner(
+            ests, gk, Xt, yt, w_list, X_val, y_val, with_validation,
+            num_classes, mesh, telem, n, d,
+        )
+    except BaseException as e:  # noqa: BLE001 — terminal telemetry record
+        telem.abort(e, candidates=B)
+        raise
+    telem.finish(candidates=B)
+    return models
+
+
+def _fit_sweep_inner(
+    ests, gk, Xt, yt, w_list, X_val, y_val, with_validation, num_classes,
+    mesh, telem, n, d,
+):
+    est0 = ests[0]
+    B = len(ests)
+    is_cls = bool(est0.is_classifier)
+    base = est0._base().copy()
+    ctx = make_shared_fit_ctx(base, Xt)
+    drift_ref = drift_reference_from_ctx(ctx)
+
+    # structural snapshots (identical across the group — enforced by gk)
+    updates = est0.updates.lower()
+    optimized = bool(est0.optimized_weights)
+    goss = (
+        (float(est0.top_rate), float(est0.other_rate))
+        if est0.sample_method.lower() == "goss"
+        else None
+    )
+    tol = float(est0.tol)
+    max_iter = int(est0.max_iter)
+    loss_name = est0.loss.lower()
+    chunk = resolved_scan_chunk(est0, n)
+    cpd = max(1, int(_tuned(
+        "configs_per_dispatch", _CONFIGS_PER_DISPATCH, n=n
+    )))
+    slab = min(B, cpd)
+
+    # ---- per-candidate host setup (reuses the fit-path cached programs,
+    # so every array below is bit-identical to what fit() would stage) ----
+    lanes_m = [int(e.num_base_learners) for e in ests]
+    max_m = max(lanes_m)
+    plans = [e._sampling_plan(n, d) for e in ests]
+    keys_pad = [_pad_rounds(k, max_m) for k, _ in plans]
+    masks_pad = [_pad_rounds(m, max_m) for _, m in plans]
+    bag_many = [e._make_bag_many_fn(n, n) for e in ests]
+    lr_all = [float(e.learning_rate) for e in ests]
+    patience = [int(e.num_rounds) for e in ests]
+    val_tols = [float(e.validation_tol) for e in ests]
+
+    if is_cls:
+        k = infer_num_classes(
+            jnp.concatenate([yt, y_val]) if y_val is not None else yt,
+            num_classes,
+        )
+        loss = est0._make_loss(k)
+        dim = loss.dim
+        y_enc = loss.encode_label(yt)
+        inits = [
+            e._init_raw_scores(Xt, yt, wb, k, dim)
+            for e, wb in zip(ests, w_list)
+        ]
+        init_models = [im for im, _ in inits]
+        init_raws = [ir for _, ir in inits]
+        preds0 = [
+            jnp.broadcast_to(ir[None, :], (n, dim)).astype(jnp.float32)
+            for ir in init_raws
+        ]
+        chunk_fn = make_cls_chunk_fn(
+            base, loss, dim, updates, optimized, goss, tol, max_iter,
+            with_validation,
+        )
+        in_axes = _CLS_IN_AXES
+        tag = "gbm_cls_sweep"
+        huber = False
+        y_enc_val = loss.encode_label(y_val) if with_validation else None
+        eval_loss = cached_program(
+            ("gbm_cls_eval", loss_name, k),
+            lambda: jax.jit(
+                lambda pred_v, y_enc_v: jnp.mean(loss.loss(y_enc_v, pred_v))
+            ),
+        )
+    else:
+        alpha_q = float(est0.alpha)
+        huber = loss_name == "huber"
+        inits = [e._fit_init(Xt, yt, wb) for e, wb in zip(ests, w_list)]
+        init_models = list(inits)
+        preds0 = [im.predict(Xt) for im in init_models]
+        if huber:
+            full_y = (
+                jnp.concatenate([yt, y_val]) if y_val is not None else yt
+            )
+            delta0 = weighted_quantile(full_y, alpha_q)
+        else:
+            delta0 = jnp.asarray(0.0, jnp.float32)
+        chunk_fn = make_reg_chunk_fn(
+            base, loss_name, alpha_q, updates, optimized, goss, tol,
+            max_iter, huber, with_validation,
+        )
+        in_axes = _REG_IN_AXES
+        tag = "gbm_reg_sweep"
+        eval_loss = cached_program(
+            ("gbm_reg_eval", loss_name, alpha_q),
+            lambda: jax.jit(
+                lambda pred_v, delta, y_v: jnp.mean(
+                    _make_reg_loss(loss_name, alpha_q, delta).loss(
+                        _make_reg_loss(loss_name, alpha_q, delta)
+                        .encode_label(y_v),
+                        pred_v[:, None],
+                    )
+                )
+            ),
+        )
+
+    valid_w = jnp.ones((n,), jnp.float32)
+    val_dummy = jnp.zeros((0,), jnp.float32)
+    guard = est0._numeric_guard(telem)
+    shard_put = _config_sharder(mesh, slab)
+
+    def sweep_program(c: int):
+        # one compiled program per (slab, chunk-length) — NEVER per
+        # candidate; the tier-2 megabatch contract pins this
+        # (analysis/contracts.py)
+        return cached_program(
+            (tag, gk, slab, c, huber, with_validation, mesh),
+            lambda: jax.jit(jax.vmap(chunk_fn, in_axes=in_axes)),
+        )
+
+    telem.phase_mark("setup")
+    models: List[Any] = [None] * B
+    for lo in range(0, B, slab):
+        lanes = list(range(lo, min(lo + slab, B)))
+        # pad the last slab by replicating its first lane: padded lanes
+        # recompute lane 0's rounds and are discarded below, keeping one
+        # program shape across slabs
+        pad_lanes = lanes + [lanes[0]] * (slab - len(lanes))
+        S = len(pad_lanes)
+
+        w_stack = jnp.stack([w_list[b] for b in pad_lanes])
+        lr_arr = jnp.asarray([lr_all[b] for b in pad_lanes], jnp.float32)
+        keys_stack = jnp.stack([keys_pad[b] for b in pad_lanes])
+        masks_stack = jnp.stack([masks_pad[b] for b in pad_lanes])
+        pred = jnp.stack([preds0[b] for b in pad_lanes])
+        slab_m = [lanes_m[b] for b in pad_lanes]
+        slab_max_m = max(slab_m)
+        if is_cls:
+            carry_extra = jnp.ones((S, dim), jnp.float32)  # alpha_ws
+        else:
+            carry_extra = jnp.stack([delta0] * S)  # delta
+        if with_validation:
+            if is_cls:
+                pred_val = jnp.stack([
+                    jnp.broadcast_to(
+                        init_raws[b][None, :], (X_val.shape[0], dim)
+                    ).astype(jnp.float32)
+                    for b in pad_lanes
+                ])
+                best0 = [
+                    float(eval_loss(pred_val[s], y_enc_val))
+                    for s in range(S)
+                ]
+            else:
+                pred_val = jnp.stack([
+                    init_models[b].predict(X_val) for b in pad_lanes
+                ])
+                best0 = [
+                    float(eval_loss(pred_val[s], carry_extra[s], y_val))
+                    for s in range(S)
+                ]
+        else:
+            width = (0, dim) if is_cls else (0,)
+            pred_val = jnp.zeros((S,) + width, jnp.float32)
+            best0 = [0.0] * S
+        if shard_put is not None:
+            (w_stack, lr_arr, keys_stack, masks_stack, pred, pred_val,
+             carry_extra) = shard_put((
+                w_stack, lr_arr, keys_stack, masks_stack, pred, pred_val,
+                carry_extra,
+            ))
+
+        carry = {"pred": pred, "pred_val": pred_val, "extra": carry_extra}
+
+        def dispatch(r0, c, scales, carry=carry, S=S,
+                     keys_stack=keys_stack, masks_stack=masks_stack,
+                     w_stack=w_stack, lr_arr=lr_arr, pad_lanes=pad_lanes):
+            bag_ws = jnp.stack([
+                bag_many[b](keys_pad[b][r0:r0 + c]) for b in pad_lanes
+            ])
+            keys_c = keys_stack[:, r0:r0 + c]
+            masks_c = masks_stack[:, r0:r0 + c]
+            if shard_put is not None:
+                bag_ws, scales = shard_put((bag_ws, scales))
+            program = sweep_program(c)
+            if is_cls:
+                (params_c, weights_c, errs, new_pred, new_pred_val,
+                 new_extra) = program(
+                    ctx, Xt, y_enc, w_stack, carry["pred"],
+                    carry["pred_val"], carry["extra"],
+                    X_val if with_validation else val_dummy,
+                    y_enc_val if with_validation else val_dummy,
+                    bag_ws, keys_c, masks_c, scales, lr_arr,
+                )
+            else:
+                (params_c, weights_c, errs, new_pred, new_pred_val,
+                 new_extra) = program(
+                    ctx, Xt, yt, w_stack, valid_w, carry["pred"],
+                    carry["pred_val"], carry["extra"],
+                    X_val if with_validation else val_dummy,
+                    y_val if with_validation else val_dummy,
+                    bag_ws, keys_c, masks_c, scales, lr_arr,
+                )
+            carry["pred"] = new_pred
+            carry["extra"] = new_extra
+            if with_validation:
+                carry["pred_val"] = new_pred_val
+            return params_c, weights_c, errs if with_validation else None
+
+        members_chunks, weights_chunks, i, v, best, val_hists = (
+            _drive_sweep_slab(
+                dispatch, slab_m, slab_max_m, chunk, with_validation,
+                best0, [patience[b] for b in pad_lanes],
+                [val_tols[b] for b in pad_lanes],
+                est0._patience_step, guard=guard, telem=telem,
+            )
+        )
+
+        all_members = (
+            _concat_rounds(members_chunks) if members_chunks else None
+        )
+        all_weights = (
+            _concat_rounds(weights_chunks) if weights_chunks else None
+        )
+        for s, b in enumerate(pad_lanes):
+            if s >= len(lanes):
+                break  # padded replica lanes
+            keep = i[s] - v[s]
+            est_b = ests[b]
+            _, masks_b = plans[b]
+            val_hist = (
+                jnp.asarray(val_hists[s], jnp.float32)
+                if with_validation
+                else None
+            )
+            lane_members = (
+                slice_pytree(
+                    jax.tree_util.tree_map(lambda x: x[s], all_members),
+                    keep,
+                )
+                if keep > 0 and all_members is not None
+                else None
+            )
+            lane_weights = (
+                all_weights[s][:keep]
+                if keep > 0 and all_weights is not None
+                else (
+                    jnp.zeros((0, dim)) if is_cls else jnp.zeros((0,))
+                )
+            )
+            if is_cls:
+                model = GBMClassificationModel(
+                    params={
+                        "members": lane_members,
+                        "weights": lane_weights,
+                        "masks": masks_b[:keep],
+                        "init_raw": init_raws[b],
+                        "val_hist": val_hist,
+                    },
+                    num_features=d,
+                    num_classes=k,
+                    num_members=keep,
+                    dim=dim,
+                    **est_b.get_params(),
+                )
+            else:
+                model = GBMRegressionModel(
+                    params={
+                        "members": lane_members,
+                        "weights": lane_weights,
+                        "masks": masks_b[:keep],
+                        "init": init_models[b].params,
+                        "val_hist": val_hist,
+                    },
+                    num_features=d,
+                    init_model=init_models[b],
+                    num_members=keep,
+                    **est_b.get_params(),
+                )
+            if drift_ref is not None:
+                model.drift_ref_ = drift_ref
+            if not hasattr(model, "fit_history_"):
+                # fitted-model contract parity with fit(): per-candidate
+                # round rows do not exist inside a batched dispatch, so
+                # sweep models carry an empty (not missing) history
+                model.fit_history_ = {
+                    "round": np.zeros(0, np.int64),
+                    "learner_index": np.zeros(0, np.int64),
+                    "duration_s": np.zeros(0, np.float64),
+                    "loss": np.zeros(0, np.float64),
+                    "step_size": np.zeros(0, np.float64),
+                }
+            models[b] = model
+    return models

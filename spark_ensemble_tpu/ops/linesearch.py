@@ -28,6 +28,51 @@ from spark_ensemble_tpu.ops.collective import preduce
 _CGOLD = 0.3819660112501051  # golden-section fraction
 
 
+def chol_solve_psd(A: jax.Array, b: jax.Array) -> jax.Array:
+    """Solve ``A x = b`` for small SPD ``A`` via an unrolled-in-XLA
+    Cholesky–Crout factorization + two triangular solves, built entirely
+    from elementwise/masked vector ops.
+
+    ``jax.scipy.linalg.solve(assume_a="pos")`` dispatches to a LAPACK
+    (batched-)Cholesky whose batched kernel is NOT bit-identical to the
+    single-matrix one on ill-conditioned inputs — so a ``vmap``-ed Newton
+    iteration (the megabatch sweep, models/gbm_sweep.py) would silently
+    diverge from the sequential fit at the last bit and then walk a
+    different backtracking path.  Masked vector ops batch to the SAME
+    per-lane arithmetic under ``vmap``, which is what pins sweep fits
+    bit-identical to sequential ones.  K here is the class-dim count
+    (<= num_classes), so the O(K^3) loop is trivially small."""
+    k = A.shape[0]
+    idx = jnp.arange(k)
+
+    def factor_col(j, L):
+        # s = A[:, j] - L[:, :j] @ L[j, :j]  (mask replaces the :j slice;
+        # broadcast-multiply + row reduce, NOT a matvec — dot_general picks
+        # a different contraction order once vmap adds a batch dim)
+        prior = (idx < j).astype(A.dtype)
+        s = A[:, j] - jnp.sum(L * (L[j] * prior)[None, :], axis=1)
+        dj = jnp.sqrt(s[j])
+        col = jnp.where(idx == j, dj, jnp.where(idx > j, s / dj, 0.0))
+        return L.at[:, j].set(col)
+
+    L = jax.lax.fori_loop(0, k, factor_col, jnp.zeros_like(A))
+
+    def fwd(i, yv):  # L y = b
+        prior = (idx < i).astype(A.dtype)
+        yi = (b[i] - jnp.sum(L[i] * yv * prior)) / L[i, i]
+        return yv.at[i].set(yi)
+
+    yv = jax.lax.fori_loop(0, k, fwd, jnp.zeros_like(b))
+
+    def bwd(t, xv):  # L^T x = y
+        i = k - 1 - t
+        later = (idx > i).astype(A.dtype)
+        xi = (yv[i] - jnp.sum(L[:, i] * xv * later)) / L[i, i]
+        return xv.at[i].set(xi)
+
+    return jax.lax.fori_loop(0, k, bwd, jnp.zeros_like(b))
+
+
 def brent_minimize(
     f: Callable[[jax.Array], jax.Array],
     lo: float,
@@ -185,7 +230,9 @@ def projected_newton_box(
         Hm = H * fm[:, None] * fm[None, :] + jnp.diag(
             jnp.where(free, 1e-6, 1.0)
         )
-        step = -jax.scipy.linalg.solve(Hm, g * fm, assume_a="pos") * fm
+        # batch-stable Cholesky solve: identical bits with and without a
+        # vmap axis (the sweep-vs-sequential bit-identity contract)
+        step = -chol_solve_psd(Hm, g * fm) * fm
 
         def bt_cond(b):
             t, fc, j = b

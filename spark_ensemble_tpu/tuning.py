@@ -28,6 +28,7 @@ from __future__ import annotations
 import contextlib
 import itertools
 import logging
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import jax
@@ -41,7 +42,8 @@ from spark_ensemble_tpu.models.base import (
     mesh_fit_kwargs,
     shared_fit_context,
 )
-from spark_ensemble_tpu.params import Param, gt_eq, in_range
+from spark_ensemble_tpu.params import Param, gt_eq, in_array, in_range
+from spark_ensemble_tpu.telemetry.events import emit_event
 
 logger = logging.getLogger(__name__)
 
@@ -152,6 +154,19 @@ class _TuningParams(Estimator):
         "either way (distinct binning configs in the grid still get "
         "distinct contexts via the learner's config key)",
     )
+    megabatch = Param(
+        "auto", in_array(["off", "auto", "on"]),
+        doc="train the sweep's (param-map, fold) candidates as vmapped "
+        "megabatch dispatches instead of one fit per candidate "
+        "(models/gbm_sweep.py; docs/selection.md#megabatch-sweeps).  "
+        "Scores are pinned bit-identical to the sequential loop.  "
+        "'auto' (default) batches GBM candidates and silently falls "
+        "back to sequential fits for unsupported estimators, under "
+        "a mesh, or when share_binning=False (a megabatch IS shared "
+        "binning); 'on' raises instead of falling back (and allows "
+        "mesh config-axis sharding, which is allclose — not bitwise); "
+        "'off' pins the sequential loop",
+    )
 
     def _maps(self) -> List[Dict[str, Any]]:
         return list(self.estimator_param_maps or [{}])
@@ -162,6 +177,130 @@ class _TuningParams(Estimator):
         if self.share_binning:
             return shared_fit_context()
         return contextlib.nullcontext()
+
+    def _emit_candidate(self, mi, fi, metric, model, wall_s, megabatch):
+        """Per-candidate telemetry + log line (satellite of the megabatch
+        PR: sweeps used to discard everything but a logger.info)."""
+        logger.info(
+            "%s map %d fold %d: %.5f%s", type(self).__name__, mi, fi,
+            metric, " [megabatch]" if megabatch else "",
+        )
+        emit_event(
+            "tuning_candidate",
+            path=self.telemetry_path or None,
+            tuner=type(self).__name__,
+            map_index=int(mi),
+            fold=int(fi),
+            metric=float(metric),
+            rounds=int(getattr(model, "num_members", 0) or 0),
+            wall_s=float(wall_s),
+            megabatch=bool(megabatch),
+        )
+
+    def _candidate_metrics(
+        self, X, y, w, maps, eval_masks, evaluator, k, mesh,
+    ) -> np.ndarray:
+        """Fit + evaluate every (param-map, fold) candidate ->
+        ``metrics[map, fold]``.
+
+        Under ``megabatch`` != 'off', candidates that share every
+        structural param train as ONE vmapped program per round chunk
+        (``fit_sweep``) — same member arrays bitwise, so the evaluator
+        scores are bit-identical to the sequential loop (pinned by
+        tests/test_megabatch.py); only fit order and wall attribution
+        differ.  Structurally distinct grid entries form separate
+        megabatch groups; unsupported candidates fall back to sequential
+        fits ('auto') or raise ('on')."""
+        mode = self.megabatch
+        base_w = (
+            w if w is not None else np.ones((X.shape[0],), np.float32)
+        )
+        metrics = np.zeros((len(maps), len(eval_masks)))
+        cands = [
+            (mi, fi, pmap, eval_mask)
+            for fi, eval_mask in enumerate(eval_masks)
+            for mi, pmap in enumerate(maps)
+        ]
+
+        def score(model, eval_mask):
+            Xe, ye = X[eval_mask], y[eval_mask]
+            we = w[eval_mask] if w is not None else None
+            return evaluator.evaluate(model, Xe, ye, sample_weight=we)
+
+        seq: List[tuple] = []
+        groups: Dict[Any, List[tuple]] = {}
+        if mode != "off" and not self.share_binning:
+            # a megabatch IS shared binning — every lane trains on one
+            # binned matrix — so an explicit opt-out wins over 'auto'
+            if mode == "on":
+                raise ValueError(
+                    "megabatch='on' requires share_binning=True: every "
+                    "sweep lane trains on the shared binned matrix"
+                )
+            mode = "off"
+        if mode != "off":
+            from spark_ensemble_tpu.models.gbm_sweep import (
+                sweep_group_key,
+                sweep_unsupported_reason,
+            )
+
+            for cand in cands:
+                est = self.estimator.copy(**cand[2])
+                reason = sweep_unsupported_reason(est, mesh)
+                if reason is None and mode == "auto" and mesh is not None:
+                    reason = (
+                        "mesh sweeps stay sequential under "
+                        "megabatch='auto' (config-axis sharding is "
+                        "allclose, not bit-identical)"
+                    )
+                if reason is not None:
+                    if mode == "on":
+                        raise ValueError(f"megabatch='on': {reason}")
+                    seq.append(cand)
+                else:
+                    groups.setdefault(sweep_group_key(est), []).append(
+                        (cand, est)
+                    )
+        else:
+            seq = cands
+
+        for items in groups.values():
+            from spark_ensemble_tpu.models.gbm_sweep import fit_sweep
+
+            ests = [est for _, est in items]
+            wts = [
+                np.where(~cand[3], base_w, 0.0).astype(np.float32)
+                for cand, _ in items
+            ]
+            t0 = time.perf_counter()
+            models = fit_sweep(
+                ests, X, y, sample_weights=wts, num_classes=k,
+                mesh=mesh if mode == "on" else None,
+                telemetry_path=self.telemetry_path or None,
+            )
+            # per-candidate wall is the batched dispatch amortized over
+            # the group — the honest number; per-round device attribution
+            # lives in the sweep_chunk events
+            per_wall = (time.perf_counter() - t0) / max(1, len(items))
+            for (cand, _), model in zip(items, models):
+                mi, fi, _, eval_mask = cand
+                metrics[mi, fi] = score(model, eval_mask)
+                self._emit_candidate(
+                    mi, fi, metrics[mi, fi], model, per_wall, True
+                )
+
+        for cand in seq:
+            mi, fi, pmap, eval_mask = cand
+            t0 = time.perf_counter()
+            model, metric = _fit_and_eval(
+                self.estimator, pmap, evaluator, X, y, w, ~eval_mask,
+                eval_mask, num_classes=k, mesh=mesh,
+            )
+            metrics[mi, fi] = metric
+            self._emit_candidate(
+                mi, fi, metric, model, time.perf_counter() - t0, False
+            )
+        return metrics
 
 
 class CrossValidator(_TuningParams):
@@ -179,18 +318,11 @@ class CrossValidator(_TuningParams):
         evaluator: Evaluator = self.evaluator
         maps = self._maps()
         folds = _kfold_indices(X.shape[0], self.num_folds, self.seed)
-        metrics = np.zeros((len(maps), self.num_folds))
         k = _full_num_classes(self.estimator, y)
         with self._binning_scope():
-            for fi, eval_mask in enumerate(folds):
-                train_mask = ~eval_mask
-                for mi, pmap in enumerate(maps):
-                    _, metric = _fit_and_eval(
-                        self.estimator, pmap, evaluator, X, y, w, train_mask,
-                        eval_mask, num_classes=k, mesh=mesh,
-                    )
-                    metrics[mi, fi] = metric
-                    logger.info("CV fold %d map %d: %.5f", fi, mi, metric)
+            metrics = self._candidate_metrics(
+                X, y, w, maps, folds, evaluator, k, mesh,
+            )
             avg = metrics.mean(axis=1)
             best_idx = int(
                 np.argmax(avg) if evaluator.is_larger_better else np.argmin(avg)
@@ -258,16 +390,11 @@ class TrainValidationSplit(_TuningParams):
         train_mask = np.zeros((n,), bool)
         train_mask[perm[:n_train]] = True
         eval_mask = ~train_mask
-        metrics = np.zeros((len(maps),))
         k = _full_num_classes(self.estimator, y)
         with self._binning_scope():
-            for mi, pmap in enumerate(maps):
-                _, metric = _fit_and_eval(
-                    self.estimator, pmap, evaluator, X, y, w, train_mask,
-                    eval_mask, num_classes=k, mesh=mesh,
-                )
-                metrics[mi] = metric
-                logger.info("TVS map %d: %.5f", mi, metric)
+            metrics = self._candidate_metrics(
+                X, y, w, maps, [eval_mask], evaluator, k, mesh,
+            )[:, 0]
             best_idx = int(
                 np.argmax(metrics)
                 if evaluator.is_larger_better

@@ -51,7 +51,12 @@ def test_tpu_capture_roundtrip(tmp_path, monkeypatch):
     capture = {"value": 130.0, "platform": "tpu", "vs_baseline": 18.6}
     with open(tmp_path / "BENCH_TPU_CAPTURE.json", "w") as f:
         json.dump(capture, f)
-    assert bench._load_last_tpu_capture() == capture
+    loaded = bench._load_last_tpu_capture()
+    # replayed captures are STAMPED stale with the capture's mtime so a
+    # reader can never mistake an embedded old TPU leg for a fresh one
+    assert loaded["tpu_capture_stale"] is True
+    assert loaded["tpu_capture_mtime"].endswith("+00:00")
+    assert {k: loaded[k] for k in capture} == capture
     # corrupt file: degrade to None, never raise (the fallback path must
     # always emit its JSON line)
     with open(tmp_path / "BENCH_TPU_CAPTURE.json", "w") as f:

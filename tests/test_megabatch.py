@@ -1,0 +1,356 @@
+"""Megabatch sweep contract (docs/selection.md#megabatch-sweeps): a whole
+CV/TVS candidate batch trained as ONE vmapped program per round chunk must
+be BIT-identical to fitting each candidate sequentially — same members,
+same weights, same early-stop round, same predictions.  The config axis is
+pure batching, never a numerics change."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_ensemble_tpu import (
+    CrossValidator,
+    DecisionTreeRegressor,
+    GBMClassifier,
+    GBMRegressor,
+    MulticlassClassificationEvaluator,
+    ParamGridBuilder,
+    RegressionEvaluator,
+    TrainValidationSplit,
+)
+from spark_ensemble_tpu.models.gbm_sweep import (
+    fit_sweep,
+    sweep_group_key,
+    sweep_unsupported_reason,
+)
+
+
+def _data(n=96, d=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] * 2 - X[:, 1] + 0.1 * rng.randn(n)).astype(np.float32)
+    return X, y
+
+
+def _tree_eq(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, z in zip(la, lb):
+        xa, za = np.asarray(x), np.asarray(z)
+        assert xa.shape == za.shape
+        assert np.array_equal(xa, za, equal_nan=True)
+
+
+@pytest.mark.slow
+def test_regressor_sweep_bit_identical_to_sequential():
+    X, y = _data()
+    base = GBMRegressor(num_base_learners=5, seed=3)
+    cands = [
+        base.copy(learning_rate=0.1, seed=1),
+        base.copy(learning_rate=0.3, seed=2, subsample_ratio=0.7),
+        base.copy(learning_rate=0.05, seed=3, num_base_learners=3),
+    ]
+    w0 = np.ones(len(y), np.float32)
+    w0[10:20] = 0.0  # tuning's zero-weight fold mask
+    sws = [w0, None, None]
+    models = fit_sweep(cands, X, y, sample_weights=sws)
+    for est, sw, m in zip(cands, sws, models):
+        ref = est.fit(X, y, sample_weight=sw)
+        assert m.num_members == ref.num_members
+        _tree_eq(m.params, ref.params)
+        assert np.array_equal(
+            np.asarray(m.predict(X)), np.asarray(ref.predict(X))
+        )
+
+
+@pytest.mark.slow
+def test_regressor_sweep_validation_patience_equivalence():
+    """Per-lane patience must stop each candidate at exactly the round the
+    sequential driver would — including lanes that stop rounds apart."""
+    X, y = _data(n=120)
+    vi = np.zeros(len(y), bool)
+    vi[::4] = True
+    base = GBMRegressor(num_base_learners=10, seed=3)
+    cands = [
+        base.copy(learning_rate=0.4, num_rounds=2, validation_tol=0.05),
+        base.copy(learning_rate=0.05, num_rounds=1, validation_tol=0.2,
+                  seed=9),
+        base.copy(learning_rate=0.2, num_base_learners=6, num_rounds=3),
+    ]
+    models = fit_sweep(cands, X, y, validation_indicator=vi)
+    for est, m in zip(cands, models):
+        ref = est.fit(X, y, validation_indicator=vi)
+        assert m.num_members == ref.num_members
+        _tree_eq(m.params, ref.params)  # includes the val_hist trace
+
+
+@pytest.mark.slow
+def test_regressor_sweep_huber():
+    X, y = _data()
+    vi = np.zeros(len(y), bool)
+    vi[::5] = True
+    base = GBMRegressor(num_base_learners=4, loss="huber", alpha=0.8)
+    cands = [base.copy(learning_rate=0.1),
+             base.copy(learning_rate=0.2, seed=5)]
+    models = fit_sweep(cands, X, y, validation_indicator=vi)
+    for est, m in zip(cands, models):
+        ref = est.fit(X, y, validation_indicator=vi)
+        _tree_eq(m.params, ref.params)
+
+
+@pytest.mark.slow
+def test_classifier_sweep_bit_identical_to_sequential():
+    X, y = _data()
+    yc = (y > 0).astype(np.float32)
+    base = GBMClassifier(num_base_learners=4, seed=2)
+    cands = [base.copy(learning_rate=0.1),
+             base.copy(learning_rate=0.3, seed=4, subsample_ratio=0.8)]
+    w0 = np.ones(len(yc), np.float32)
+    w0[:15] = 0.0
+    models = fit_sweep(cands, X, yc, sample_weights=[w0, None])
+    for est, sw, m in zip(cands, [w0, None], models):
+        ref = est.fit(X, yc, sample_weight=sw)
+        _tree_eq(m.params, ref.params)
+        assert np.array_equal(
+            np.asarray(m.predict_proba(X)), np.asarray(ref.predict_proba(X))
+        )
+
+
+@pytest.mark.slow
+def test_sweep_slab_padding_invariant():
+    """3 candidates at configs_per_dispatch=2 force a padded second slab;
+    padded lanes are computed-and-discarded, so results must match the
+    one-slab fit bit for bit."""
+    from spark_ensemble_tpu import autotune
+
+    X, y = _data()
+    base = GBMRegressor(num_base_learners=4, seed=1)
+    cands = [base.copy(learning_rate=0.1 + 0.1 * i, seed=i)
+             for i in range(3)]
+    wide = fit_sweep([e.copy() for e in cands], X, y)
+    with autotune.override(configs_per_dispatch=2):
+        narrow = fit_sweep([e.copy() for e in cands], X, y)
+    for a, b in zip(wide, narrow):
+        _tree_eq(a.params, b.params)
+
+
+def test_sweep_rejects_structural_mix_and_unsupported():
+    X, y = _data()
+    a = GBMRegressor(num_base_learners=2)
+    b = a.copy(base_learner=DecisionTreeRegressor(max_depth=7))
+    assert sweep_group_key(a) != sweep_group_key(b)
+    with pytest.raises(ValueError, match="structural"):
+        fit_sweep([a, b], X, y)
+    # batchable params do NOT split the group
+    assert sweep_group_key(a) == sweep_group_key(
+        a.copy(learning_rate=0.7, seed=9, num_base_learners=30)
+    )
+    assert sweep_unsupported_reason(a) is None
+    assert "checkpoint" in sweep_unsupported_reason(
+        a.copy(checkpoint_dir="/tmp/ck")
+    )
+    assert "megabatch" in sweep_unsupported_reason(DecisionTreeRegressor())
+    with pytest.raises(ValueError, match="sweep"):
+        fit_sweep([a.copy(checkpoint_dir="/tmp/ck")], X, y)
+
+
+def test_chol_solve_psd_lane_independent_and_accurate():
+    """The hand-rolled Cholesky solve exists because LAPACK's batched
+    kernel under vmap reorders arithmetic per lane.  Pin the property the
+    sweep needs from it: within ONE batched program every lane's result
+    depends only on that lane's inputs (permuting lanes permutes outputs
+    bit-for-bit — the invariant that makes padded lanes harmless), and the
+    solve itself is accurate against a float64 reference.  The sweep-vs-
+    sequential bit-identity itself is pinned end-to-end above."""
+    from spark_ensemble_tpu.ops.linesearch import chol_solve_psd
+
+    rng = np.random.RandomState(0)
+    batched = jax.jit(jax.vmap(chol_solve_psd))
+    for k in (1, 3, 7, 26):
+        A = rng.randn(8, k, k).astype(np.float32)
+        A = np.einsum("bij,bkj->bik", A, A) + 1e-3 * np.eye(k, dtype=np.float32)
+        b = rng.randn(8, k).astype(np.float32)
+        out = np.asarray(batched(A, b))
+        perm = rng.permutation(8)
+        shuffled = np.asarray(batched(A[perm], b[perm]))
+        assert np.array_equal(out[perm], shuffled)
+        ref = np.linalg.solve(
+            A.astype(np.float64), b.astype(np.float64)[..., None]
+        )[..., 0]
+        np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-3)
+
+
+@pytest.mark.slow
+def test_cv_megabatch_matches_sequential():
+    X, y = _data(n=150)
+    grid = (
+        ParamGridBuilder()
+        .add_grid("learning_rate", [0.1, 0.3])
+        .add_grid("seed", [0, 7])
+        .build()
+    )
+    kw = dict(
+        estimator=GBMRegressor(num_base_learners=3),
+        estimator_param_maps=grid,
+        evaluator=RegressionEvaluator(metric="rmse"),
+        num_folds=2,
+        seed=0,
+    )
+    seq = CrossValidator(megabatch="off", **kw).fit(X, y)
+    mb = CrossValidator(megabatch="on", **kw).fit(X, y)
+    auto = CrossValidator(megabatch="auto", **kw).fit(X, y)
+    assert seq.avg_metrics == mb.avg_metrics == auto.avg_metrics
+    assert seq.best_index == mb.best_index == auto.best_index
+
+
+@pytest.mark.slow
+def test_cv_megabatch_structural_grid_partitions():
+    """A grid that sweeps a structural param (num_base_learners is
+    batchable, base_learner depth is NOT) partitions into one megabatch
+    per group key and still matches sequential exactly."""
+    X, y = _data(n=120)
+    grid = [
+        {"learning_rate": 0.1,
+         "base_learner": DecisionTreeRegressor(max_depth=2)},
+        {"learning_rate": 0.3,
+         "base_learner": DecisionTreeRegressor(max_depth=2)},
+        {"learning_rate": 0.1,
+         "base_learner": DecisionTreeRegressor(max_depth=4)},
+    ]
+    kw = dict(
+        estimator=GBMRegressor(num_base_learners=3),
+        estimator_param_maps=grid,
+        evaluator=RegressionEvaluator(metric="rmse"),
+        num_folds=2,
+        seed=1,
+    )
+    seq = CrossValidator(megabatch="off", **kw).fit(X, y)
+    mb = CrossValidator(megabatch="on", **kw).fit(X, y)
+    assert seq.avg_metrics == mb.avg_metrics
+    assert seq.best_index == mb.best_index
+
+
+@pytest.mark.slow
+def test_tvs_megabatch_matches_sequential_classifier():
+    X, y = _data(n=150)
+    yc = (y > 0).astype(np.float32)
+    grid = ParamGridBuilder().add_grid(
+        "learning_rate", [0.1, 0.3, 0.6]
+    ).build()
+    kw = dict(
+        estimator=GBMClassifier(num_base_learners=3, loss="logloss"),
+        estimator_param_maps=grid,
+        evaluator=MulticlassClassificationEvaluator(metric="accuracy"),
+        train_ratio=0.75,
+        seed=0,
+    )
+    seq = TrainValidationSplit(megabatch="off", **kw).fit(X, yc)
+    mb = TrainValidationSplit(megabatch="on", **kw).fit(X, yc)
+    assert seq.validation_metrics == mb.validation_metrics
+    assert seq.best_index == mb.best_index
+
+
+def test_megabatch_on_raises_for_unsupported_auto_falls_back():
+    X, y = _data()
+    grid = ParamGridBuilder().add_grid("max_depth", [2, 3]).build()
+    kw = dict(
+        estimator=DecisionTreeRegressor(),
+        estimator_param_maps=grid,
+        evaluator=RegressionEvaluator(metric="rmse"),
+        num_folds=2,
+        seed=0,
+    )
+    with pytest.raises(ValueError, match="megabatch"):
+        CrossValidator(megabatch="on", **kw).fit(X, y)
+    seq = CrossValidator(megabatch="off", **kw).fit(X, y)
+    auto = CrossValidator(megabatch="auto", **kw).fit(X, y)
+    assert seq.avg_metrics == auto.avg_metrics
+    assert seq.best_index == auto.best_index
+
+
+def test_megabatch_requires_share_binning():
+    """A megabatch IS shared binning: an explicit share_binning=False
+    wins over 'auto' (sequential fits, bit-identical scores) and
+    conflicts with 'on' (raise before any fit)."""
+    X, y = _data()
+    grid = ParamGridBuilder().add_grid("learning_rate", [0.1, 0.3]).build()
+    kw = dict(
+        estimator=GBMRegressor(num_base_learners=2),
+        estimator_param_maps=grid,
+        evaluator=RegressionEvaluator(metric="rmse"),
+        num_folds=2,
+        seed=0,
+    )
+    with pytest.raises(ValueError, match="share_binning"):
+        CrossValidator(megabatch="on", share_binning=False, **kw).fit(X, y)
+    seq = CrossValidator(megabatch="off", share_binning=False, **kw).fit(X, y)
+    auto = CrossValidator(megabatch="auto", share_binning=False, **kw).fit(X, y)
+    assert seq.avg_metrics == auto.avg_metrics
+    assert seq.best_index == auto.best_index
+
+
+@pytest.mark.slow
+def test_tuning_candidate_events_emitted(tmp_path):
+    """Every (map, fold) candidate lands one tuning_candidate event with
+    its attribution fields, and the sweep fit emits per-chunk round-ledger
+    events (the per-candidate cost attribution the report renders)."""
+    import json
+
+    X, y = _data(n=120)
+    path = str(tmp_path / "tune.jsonl")
+    grid = ParamGridBuilder().add_grid("learning_rate", [0.1, 0.3]).build()
+    CrossValidator(
+        estimator=GBMRegressor(num_base_learners=2),
+        estimator_param_maps=grid,
+        evaluator=RegressionEvaluator(metric="rmse"),
+        num_folds=2,
+        seed=0,
+        megabatch="on",
+        telemetry_path=path,
+    ).fit(X, y)
+    events = [json.loads(line) for line in open(path)]
+    cands = [e for e in events if e.get("event") == "tuning_candidate"]
+    assert len(cands) == 4  # 2 maps x 2 folds
+    assert {(e["map_index"], e["fold"]) for e in cands} == {
+        (0, 0), (0, 1), (1, 0), (1, 1)
+    }
+    for e in cands:
+        assert e["tuner"] == "CrossValidator"
+        assert e["megabatch"] is True
+        assert e["rounds"] >= 1
+        assert e["wall_s"] >= 0.0
+        assert isinstance(e["metric"], float)
+    chunks = [e for e in events if e.get("event") == "sweep_chunk"]
+    assert chunks and all(e["candidates"] >= 1 for e in chunks)
+    assert all("per_candidate_round_s" in e for e in chunks)
+
+
+def test_telemetry_report_renders_tuning_section(tmp_path, capsys):
+    import json
+    import sys
+
+    sys.path.insert(0, "tools")
+    try:
+        import telemetry_report
+    finally:
+        sys.path.pop(0)
+
+    path = str(tmp_path / "tune.jsonl")
+    with open(path, "w") as fh:
+        for mi, fi, metric in ((0, 0, 0.5), (0, 1, 0.6), (1, 0, 0.4),
+                               (1, 1, 0.3)):
+            fh.write(json.dumps({
+                "event": "tuning_candidate", "fit_id": "tuner",
+                "tuner": "CrossValidator", "map_index": mi, "fold": fi,
+                "metric": metric, "rounds": 3, "wall_s": 0.25,
+                "megabatch": True,
+            }) + "\n")
+    assert telemetry_report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "== tuning ==" in out
+    assert "4 candidates (2 maps x 2 folds)" in out
+    assert "megabatch 4/4" in out
+    # a stream of only tuning_candidate events must NOT render as a fit
+    assert "== tuner ==" not in out

@@ -183,6 +183,82 @@ def test_cv_pipeline_fold_missing_top_class():
     assert model.best_model.num_classes == 3
 
 
+@pytest.mark.parametrize("num_folds", [2, 4])
+def test_cv_megabatch_bit_identical_across_grids_and_folds(num_folds):
+    """Property pin (docs/selection.md#megabatch-sweeps): for any grid of
+    batchable params and any fold count, megabatch CV must produce
+    avg_metrics and best_index BIT-identical to the sequential loop —
+    the config axis is batching, never a numerics change."""
+    rng = np.random.RandomState(num_folds)
+    X = rng.randn(240, 6).astype(np.float32)
+    y = (X[:, 0] - 2.0 * X[:, 1] + 0.1 * rng.randn(240)).astype(np.float32)
+    grid = (
+        ParamGridBuilder()
+        .add_grid("learning_rate", [0.05, 0.3])
+        .add_grid("num_base_learners", [2, 4])
+        .add_grid("subsample_ratio", [0.7, 1.0])
+        .build()
+    )
+    kw = dict(
+        estimator=GBMRegressor(seed=3),
+        estimator_param_maps=grid,
+        evaluator=RegressionEvaluator(metric="rmse"),
+        num_folds=num_folds,
+        seed=num_folds,
+    )
+    seq = CrossValidator(megabatch="off", **kw).fit(X, y)
+    mb = CrossValidator(megabatch="on", **kw).fit(X, y)
+    assert seq.avg_metrics == mb.avg_metrics
+    assert seq.best_index == mb.best_index
+
+
+def test_megabatch_sweep_patience_property_random_configs():
+    """Randomized early-stopping property: candidates drawing random
+    batchable params (including num_rounds patience and validation_tol)
+    with a validation split must stop at exactly the sequential round and
+    match the sequential model bit for bit, lane by lane."""
+    import jax
+
+    from spark_ensemble_tpu.models.gbm_sweep import fit_sweep
+
+    rng = np.random.RandomState(7)
+    X = rng.randn(160, 6).astype(np.float32)
+    y = (np.sin(X[:, 0]) + X[:, 1] * X[:, 2] + 0.2 * rng.randn(160)).astype(
+        np.float32
+    )
+    vi = rng.rand(160) < 0.25
+    base = GBMRegressor(seed=0)
+    cands = [
+        base.copy(
+            learning_rate=float(rng.choice([0.05, 0.1, 0.3, 0.6])),
+            seed=int(rng.randint(100)),
+            subsample_ratio=float(rng.choice([0.6, 0.8, 1.0])),
+            subspace_ratio=float(rng.choice([0.7, 1.0])),
+            num_base_learners=int(rng.randint(3, 10)),
+            num_rounds=int(rng.choice([1, 2, 3])),
+            validation_tol=float(rng.choice([0.01, 0.1, 0.3])),
+        )
+        for _ in range(6)
+    ]
+    models = fit_sweep([e.copy() for e in cands], X, y,
+                       validation_indicator=vi)
+    stop_rounds = set()
+    for est, m in zip(cands, models):
+        ref = est.fit(X, y, validation_indicator=vi)
+        assert m.num_members == ref.num_members
+        stop_rounds.add(m.num_members)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(m.params),
+            jax.tree_util.tree_leaves(ref.params),
+        ):
+            assert np.array_equal(
+                np.asarray(a), np.asarray(b), equal_nan=True
+            )
+    # the draw must actually exercise divergent stopping, or the property
+    # silently weakens to the lockstep case
+    assert len(stop_rounds) > 1
+
+
 def test_cv_and_pipeline_mesh_passthrough():
     """mesh= flows from CrossValidator / Pipeline into every mesh-aware
     estimator fit — a CV sweep over a distributed GBM trains each

@@ -86,6 +86,14 @@ METRICS: Dict[str, Any] = {
     "swap_p99_ratio":             ("lower", 0.50, 1.0),
     "scale_up_warm_ms":           ("lower", 0.50, 50.0),
     "dropped_requests":           ("lower", 0.0, 0.0),
+    # megabatch sweep leg (docs/selection.md#megabatch-sweeps): wall-clock
+    # of 32 sequential candidate fits over one vmapped fit_sweep() at the
+    # same configs, warm programs both legs.  The ratio prices per-round
+    # dispatch amortization — the thing the config axis exists to buy —
+    # so a collapse back toward 1.0 means the batched dispatch quietly
+    # stopped batching.  Ratio of two noisy walls on shared CI runners:
+    # wide rel floor.
+    "sweep_speedup":              ("higher", 0.30, 0.0),
 }
 
 

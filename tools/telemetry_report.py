@@ -311,6 +311,76 @@ def fleet_slo_line(fit_events: List[dict]) -> Optional[str]:
     return "  ".join(parts)
 
 
+def sweep_ledger_line(fit_events: List[dict]) -> Optional[str]:
+    """Per-candidate round ledger for megabatch sweep fits
+    (models/gbm_sweep.py): chunked dispatch count, config-lane width,
+    live lane-rounds executed vs the slab's padded capacity (lanes past
+    their own round budget or patience stop ride at scale 0 — the
+    successive-halving occupancy), and the amortized per-candidate round
+    cost."""
+    chunks = [e for e in fit_events if e.get("event") == "sweep_chunk"]
+    if not chunks:
+        return None
+    active = sum(int(e.get("active_lane_rounds", 0)) for e in chunks)
+    capacity = sum(
+        int(e.get("rounds", 0)) * int(e.get("candidates", 0))
+        for e in chunks
+    )
+    wall = sum(float(e.get("wall_s", 0.0)) for e in chunks)
+    lanes = max(int(e.get("candidates", 0)) for e in chunks)
+    parts = [
+        f"sweep: {len(chunks)} chunk dispatches  {lanes} lanes  "
+        f"{active} live lane-rounds"
+    ]
+    if capacity:
+        parts.append(f"occupancy {100.0 * active / capacity:.1f}%")
+    if active:
+        parts.append(f"{wall / active * 1e3:.2f}ms/candidate-round")
+    return "  ".join(parts)
+
+
+def tuning_section(events: List[dict]) -> Optional[str]:
+    """Hyperparameter-sweep summary (docs/selection.md#megabatch-sweeps)
+    from the ``tuning_candidate`` events CrossValidator /
+    TrainValidationSplit emit per (param-map, fold) candidate: the
+    candidate count and megabatch share per tuner, then a per-map table
+    of mean metric, fitted rounds and attributed wall.  Metric direction
+    lives in the evaluator, so rows render in map order — the tuner's
+    own best_index is the verdict, this table is the evidence."""
+    cands = [e for e in events if e.get("event") == "tuning_candidate"]
+    if not cands:
+        return None
+    lines = []
+    by_tuner: Dict[str, List[dict]] = {}
+    for e in cands:
+        by_tuner.setdefault(e.get("tuner", "?"), []).append(e)
+    for tuner in sorted(by_tuner):
+        evs = by_tuner[tuner]
+        maps = len({int(e.get("map_index", 0)) for e in evs})
+        folds = len({int(e.get("fold", 0)) for e in evs})
+        mb = sum(1 for e in evs if e.get("megabatch"))
+        wall = sum(float(e.get("wall_s", 0.0)) for e in evs)
+        lines.append(
+            f"{tuner}: {len(evs)} candidates ({maps} maps x {folds} "
+            f"folds)  megabatch {mb}/{len(evs)}  wall {wall:.3f}s"
+        )
+        by_map: Dict[int, List[dict]] = {}
+        for e in evs:
+            by_map.setdefault(int(e.get("map_index", 0)), []).append(e)
+        lines.append(
+            f"{'map':>4}  {'mean_metric':>12}  {'rounds':>7}  {'wall_s':>8}"
+        )
+        for mi in sorted(by_map):
+            mevs = by_map[mi]
+            mean = sum(float(e.get("metric", 0.0)) for e in mevs) / len(mevs)
+            rounds = max(int(e.get("rounds", 0)) for e in mevs)
+            mwall = sum(float(e.get("wall_s", 0.0)) for e in mevs)
+            lines.append(
+                f"{mi:>4}  {mean:>12.6g}  {rounds:>7}  {mwall:>8.3f}"
+            )
+    return "\n".join(lines)
+
+
 def quality_section(events: List[dict]) -> Optional[str]:
     """Model-quality plane summary (docs/quality.md) from the
     ``drift_window`` / ``shadow_eval`` / ``quality_alert`` events plus
@@ -451,6 +521,9 @@ def render_fit(fit_id: str, fit_events: List[dict]) -> str:
     fleet = fleet_slo_line(fit_events)
     if fleet:
         lines.append(fleet)
+    sweep = sweep_ledger_line(fit_events)
+    if sweep:
+        lines.append(sweep)
     probe = next(
         (e for e in fit_events if e.get("event") == "phase_probe"), None
     )
@@ -535,10 +608,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not fits:
             print(f"no fit_id matching {args.fit!r}")
             return 1
-    quality_only = {"drift_window", "shadow_eval", "quality_alert"}
+    # events summarized in their own section below — a stream holding
+    # nothing else is not a fit and must not render as an empty one
+    sectioned = {
+        "drift_window", "shadow_eval", "quality_alert", "tuning_candidate",
+    }
     for fit_id in sorted(fits):
-        if all(e.get("event") in quality_only for e in fits[fit_id]):
-            continue  # summarized in == model quality == below
+        if all(e.get("event") in sectioned for e in fits[fit_id]):
+            continue  # summarized in == model quality == / == tuning ==
         print(render_fit(fit_id, fits[fit_id]))
         print()
     programs = program_table(events)
@@ -552,6 +629,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     if quality:
         print("== model quality ==")
         print(quality)
+        print()
+    tuning = tuning_section([ev for evs in fits.values() for ev in evs])
+    if tuning:
+        print("== tuning ==")
+        print(tuning)
         print()
     if streams is not None:
         skew = podview.skew_report(streams)
