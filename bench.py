@@ -1551,6 +1551,115 @@ def inner():
         out["sweep_speedup"] = sweep_ab["speedup"]
         out["configs_per_dispatch"] = sweep_ab["configs_per_dispatch"]
 
+    # gradient-based sampling Pareto leg (docs/sampling.md): the letter
+    # shape fit at sampling='none' vs GOSS(0.2/0.1) vs MVS over a short
+    # round budget.  Per-round throughput alone overstates sampling (a
+    # sampled round is cheaper AND weaker), so the headline combines it
+    # with rounds-to-equal-accuracy into time-to-accuracy: the target is
+    # the none leg's accuracy a third of the way through its budget (the
+    # early-mid regime sampling exists for — near the plateau every
+    # sampled variant needs unboundedly many rounds), each leg's
+    # rounds-to-target comes from a take(k) accuracy scan, and
+    #   sampling_speedup = max over methods of
+    #       (none s/round * rounds_none) / (method s/round * rounds_m)
+    # tools/perf_sentinel.py floors sampling_speedup vs
+    # PERF_BASELINE.json; hbm_ratio is the modeled per-round ledger
+    # traffic of the sampled round against the full-row round.
+    sampling_ab = {}
+    try:
+        sp_rounds = _env_int("BENCH_SAMPLING_ROUNDS", 15)
+        probe_n = min(4096, X.shape[0])
+        Xp, yp = X[:probe_n], y[:probe_n]
+
+        def _acc_curve(m):
+            return [
+                float(np.mean(np.asarray(m.take(k).predict(Xp)) == yp))  # graftlint: ignore[unfenced-blocking-read] -- accuracy scan after the timed fit, outside the dispatch window
+                for k in range(1, sp_rounds + 1)
+            ]
+
+        def _rounds_to(curve, target):
+            for i, acc in enumerate(curve):
+                if acc >= target:
+                    if i == 0:
+                        return 1.0
+                    lo = curve[i - 1]
+                    return i + (target - lo) / max(acc - lo, 1e-9)
+            return None
+
+        legs = {}
+        for method in ("none", "goss", "mvs"):
+            kw = {} if method == "none" else {"sampling": method}
+            if method == "goss":
+                kw.update(top_rate=0.2, other_rate=0.1)
+            sp_est = est.copy(num_base_learners=sp_rounds, **kw)
+            with record_fits() as sp_rec:  # ledger ride-along on warmup
+                _block_on_model(sp_est.copy().fit(X, y))
+            sp_model, sp_s = _timed_fit(sp_est, X, y)
+            hbm = next(
+                (
+                    e["hbm_bytes_est"]
+                    for e in sp_rec.events
+                    if e.get("event") == "round_end"
+                    and "hbm_bytes_est" in e
+                ),
+                None,
+            )
+            saved = next(
+                (
+                    e["hbm_saved_est"]
+                    for e in sp_rec.events
+                    if e.get("event") == "round_end"
+                    and "hbm_saved_est" in e
+                ),
+                None,
+            )
+            legs[method] = {
+                "seconds": round(sp_s, 3),
+                "iters_per_sec": round(sp_rounds / sp_s, 3),
+                "curve": _acc_curve(sp_model),
+                "hbm_bytes_est": hbm,
+                "hbm_saved_est": saved,
+            }
+        target = legs["none"]["curve"][max(sp_rounds // 3, 1) - 1]
+        per_round_none = legs["none"]["seconds"] / sp_rounds
+        best = 0.0
+        for method in ("goss", "mvs"):
+            leg = legs[method]
+            r_m = _rounds_to(leg["curve"], target)
+            r_none = _rounds_to(legs["none"]["curve"], target)
+            leg["rounds_to_equal_accuracy"] = (
+                round(r_m, 2) if r_m is not None else None
+            )
+            if r_m is None or r_none is None:
+                continue
+            per_round_m = leg["seconds"] / sp_rounds
+            leg["speedup_at_equal_accuracy"] = round(
+                (per_round_none * r_none) / (per_round_m * r_m), 3
+            )
+            best = max(best, leg["speedup_at_equal_accuracy"])
+        hbm_none = legs["none"]["hbm_bytes_est"]
+        for method in ("goss", "mvs"):
+            h = legs[method]["hbm_bytes_est"]
+            if hbm_none and h:
+                legs[method]["hbm_ratio"] = round(h / hbm_none, 3)
+        for leg in legs.values():
+            leg["curve"] = [round(a, 4) for a in leg["curve"]]
+        sampling_ab = {
+            "rounds": sp_rounds,
+            "target_accuracy": round(target, 4),
+            "legs": legs,
+        }
+        if best > 0:
+            sampling_ab["sampling_speedup"] = round(best, 3)
+    except Exception as e:  # noqa: BLE001 - carry, keep going
+        sampling_ab = {"error": str(e)[:200]}
+    out["sampling"] = sampling_ab
+    if "sampling_speedup" in sampling_ab:
+        out["sampling_speedup"] = sampling_ab["sampling_speedup"]
+        goss_leg = sampling_ab["legs"]["goss"]
+        if "hbm_ratio" in goss_leg:
+            out["sampling_hbm_ratio"] = goss_leg["hbm_ratio"]
+
     extras = {}
     if os.environ.get("BENCH_FULL") == "1":
         extras = _bench_full_extras()

@@ -829,6 +829,66 @@ def _trace_megabatch(report: ContractReport) -> None:
         )
 
 
+def _trace_sampling(report: ContractReport) -> None:
+    """Trace the gradient-based sampling stage (models/gbm.py GOSS/MVS).
+
+    The ladder contract: the traced-program inventory depends on the
+    compacted row BUCKET only, never on the sample rates — the
+    rate-derived scalars (k_top/k_rand/amp/lambda) ride the dispatch as
+    traced device operands, so two fits whose rates land in the same
+    pow2 bucket must re-enter the SAME compiled program set.  Traced at
+    GOSS (0.2, 0.1) and (0.3, 0.15) over the canonical 64-row fixture
+    with the bucket floor pinned low enough that both land in the
+    32-row bucket; any program-set difference is a ``sampling``
+    violation and the first pair pins the ``gbm_regressor.fit_sampled``
+    budget."""
+    from spark_ensemble_tpu.autotune import override
+    from spark_ensemble_tpu.models.base import observe_program_calls
+
+    import spark_ensemble_tpu as se
+
+    entry = "gbm_regressor.fit_sampled"
+    X, y = _canonical_data(False)
+    base = se.GBMRegressor(
+        base_learner=se.DecisionTreeRegressor(max_depth=3),
+        num_base_learners=3,
+        sampling="goss",
+        seed=0,
+    )
+    sets: Dict[Tuple[float, float], frozenset] = {}
+    for rates in ((0.2, 0.1), (0.3, 0.15)):
+        rec = _ProgramRecorder()
+        try:
+            with override(sample_bucket_floor=16):
+                with observe_program_calls(rec):
+                    base.copy(top_rate=rates[0], other_rate=rates[1]).fit(
+                        X, y
+                    )
+        except Exception as e:  # noqa: BLE001
+            report.skipped[entry] = f"sampled fit not traceable: {e!r:.120}"
+            return
+        sets[rates] = frozenset(rec.programs)
+        for (tag, _), jaxpr in rec.programs.items():
+            if jaxpr is not None:
+                _check_jaxpr(entry, tag, jaxpr, report.violations)
+    (r_a, set_a), (r_b, set_b) = sorted(sets.items())
+    report.budgets[entry] = len(set_a)
+    if set_a != set_b:
+        diff = sorted(
+            tag for tag, _ in set_a.symmetric_difference(set_b)
+        )
+        report.violations.append(
+            ContractViolation(
+                "sampling",
+                entry,
+                f"program set varies with sample rates ({r_a}: "
+                f"{len(set_a)} programs, {r_b}: {len(set_b)}; differing "
+                f"tags {diff[:6]}): rates must stay traced operands — "
+                "only the pow2 row bucket may shape a program",
+            )
+        )
+
+
 def _trace_tracing(report: ContractReport) -> None:
     """Trace the causal-tracing plane's own budget (telemetry/trace.py).
 
@@ -1099,6 +1159,8 @@ def trace_contracts(
             _trace_streaming_dist(report)
         if wanted is None or "megabatch" in wanted:
             _trace_megabatch(report)
+        if wanted is None or "sampling" in wanted:
+            _trace_sampling(report)
         if wanted is None or "tracing" in wanted:
             _trace_tracing(report)
         if wanted is None or "operator" in wanted:

@@ -187,6 +187,30 @@ TUNABLES = TunableSpace((
         "slabs of the same program shape)",
         site="models/gbm_sweep.py:_CONFIGS_PER_DISPATCH",
     ),
+    Tunable(
+        "sample_bucket_floor", 256, (64, 128, 256, 512, 1024),
+        doc="smallest compacted row bucket the gradient-based sampling "
+        "stage gathers into (GOSS/MVS); tiny sample targets round up to "
+        "it so the pow2 bucket ladder, and with it the traced-program "
+        "inventory, stays O(1) across sample ratios",
+        site="models/gbm.py:_resolved_sampling",
+    ),
+    Tunable(
+        "goss_top_rate", 0.2, (0.1, 0.2, 0.3),
+        doc="fraction of rows kept deterministically by |grad| rank when "
+        "sampling='goss' and the estimator's top_rate was left at its "
+        "default (hand-set rates always win)",
+        site="models/gbm.py:_resolved_sampling",
+        kind="choice",
+    ),
+    Tunable(
+        "goss_other_rate", 0.1, (0.05, 0.1, 0.2),
+        doc="fraction of the remaining rows drawn uniformly (amplified by "
+        "(1-a)/b) when sampling='goss' and other_rate was left at its "
+        "default (hand-set rates always win)",
+        site="models/gbm.py:_resolved_sampling",
+        kind="choice",
+    ),
 ))
 
 

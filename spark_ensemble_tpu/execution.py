@@ -203,6 +203,13 @@ class RoundAdapter:
     #: default, kept by bare test adapters) traces nothing
     telem = None
 
+    #: extra fields merged into every round_chunk span the executor opens
+    #: (a dict, e.g. the GBM sampling stage's ``{"sampling": "goss",
+    #: "sample_bucket": 256}``) so per-chunk trace rows carry the
+    #: adapter-level configuration that shaped the dispatch; None adds
+    #: nothing
+    span_fields = None
+
     def should_continue(self) -> bool:
         raise NotImplementedError
 
@@ -254,6 +261,7 @@ class RoundExecutor:
                         NULL_SPAN if telem is None else telem.begin_span(
                             "round_chunk", chunk_seq=seq,
                             speculative=bool(pending),
+                            **(getattr(a, "span_fields", None) or {}),
                         ),
                         a.launch(),
                     ))

@@ -899,6 +899,41 @@ class BaseLearner(Estimator):
         preds = jax.vmap(lambda p: self.predict_fn(p, X))(params)
         return params, preds.T
 
+    def ctx_gather_rows(self, ctx: Any, idx: jax.Array) -> Any:
+        """Gather the fit ctx's row-indexed leaves into a compacted buffer
+        (gradient-based row sampling, models/gbm.py): ``idx[m]`` selects the
+        surviving rows, so downstream histogram/leaf kernels genuinely
+        process ``m`` rows per dispatch instead of masking ``n``.  The
+        default ctx is the feature matrix itself; learners whose ctx mixes
+        row-indexed and replicated leaves override (trees gather the binned
+        matrix and keep thresholds whole)."""
+        return jax.tree_util.tree_map(lambda leaf: leaf[idx], ctx)
+
+    def fit_gathered_and_direction(
+        self, ctx_s, y_s, w_s, feature_mask, key, X, axis_name=None
+    ):
+        """Member fit over a row-compacted ctx (``ctx_gather_rows``) PLUS
+        the fitted member's predictions on the FULL rows -> (params,
+        pred[n]).  The fit sees only the gathered survivors; the direction
+        re-routes every original row through the fitted model (for trees
+        the raw-threshold route is bit-identical to the binned route —
+        ``test_binned_and_raw_predict_agree``)."""
+        params = self.fit_from_ctx(
+            ctx_s, y_s, w_s, feature_mask, key, axis_name=axis_name
+        )
+        return params, self.predict_fn(params, X)
+
+    def fit_gathered_many_and_directions(
+        self, ctx_s, ys_s, ws_s, feature_masks, keys, X, axis_name=None
+    ):
+        """Fused-member analogue of ``fit_gathered_and_direction`` ->
+        (stacked params, preds[n, M]); the full-row re-route uses the
+        learner's fused multi-member predict."""
+        params = self.fit_many_from_ctx(
+            ctx_s, ys_s, ws_s, feature_masks, keys, axis_name=axis_name
+        )
+        return params, self.predict_many_fn(params, X).T
+
     def ctx_specs(self, ctx: Any, data_axis: str):
         """``shard_map`` PartitionSpecs for the fit ctx under row sharding:
         row-indexed leaves sharded over ``data_axis``, the rest replicated.

@@ -118,21 +118,31 @@ def concat_pytrees(chunks: List[Any]):
     )
 
 
-def _round_cost(base, n: int, d: int, members: int):
+def _round_cost(base, n: int, d: int, members: int, sample_plan=None):
     """Static per-round cost model for telemetry round events (ops/tree.py
     ``round_cost_est``): resolved histogram tier, packed-lane width, HBM
     bytes and MXU flops per round.  ``members`` is the number of trees a
     round fits (1 for the regressor, the class dim for the classifier).
-    None when the base learner is not a histogram tree."""
+    With a compacted-sampling plan the histogram costs are modeled at the
+    bucket size and the ledger row carries the predicted HBM saving
+    (``sampled_rows``/``sample_bucket``/``hbm_saved_est``).  None when the
+    base learner is not a histogram tree."""
     try:
         from spark_ensemble_tpu.ops.tree import round_cost_est
 
-        return round_cost_est(
+        out = round_cost_est(
             n=int(n), d=int(d), k=1, M=int(members),
             max_depth=int(base.max_depth), max_bins=int(base.max_bins),
             hist=str(getattr(base, "hist", "auto")),
             hist_precision=str(getattr(base, "hist_precision", "highest")),
+            sampled_rows=(
+                int(sample_plan["bucket"]) if sample_plan else None
+            ),
         )
+        if out is not None and sample_plan is not None:
+            out["sampled_rows"] = int(sample_plan["sampled_rows"])
+            out["sample_bucket"] = int(sample_plan["bucket"])
+        return out
     except (AttributeError, TypeError, ValueError):
         return None
 
@@ -187,6 +197,35 @@ class _GBMParams(CheckpointableParams, Estimator):
         "small-gradient rest (kept rows amplified by the reciprocal "
         "keep-rate, so the rest's gradient mass is unbiased)",
     )
+    sampling = Param(
+        "none", in_array(["none", "goss", "mvs"]),
+        doc="gradient-based row sampling with TRUE row compaction "
+        "(docs/sampling.md): per round the rows are ranked on device by "
+        "gradient magnitude ('goss', arXiv:1911.08820) or by minimal-"
+        "variance sampling probability ('mvs'), and the survivors are "
+        "GATHERED into a power-of-two-bucketed compacted buffer — the "
+        "histogram tiers genuinely process fewer rows per dispatch, "
+        "unlike sample_method='goss' which only zero-weights them.  "
+        "Survivor weights carry the (1-a)/b amplification so split gains "
+        "stay unbiased.  'goss' keeps ceil(top_rate*n) rows by |grad| "
+        "plus exactly ceil(other_rate*n) uniform draws from the rest; "
+        "'mvs' keeps an expected (top_rate+other_rate)*n rows with "
+        "probability min(1, sqrt(g^2+mvs_lambda)/mu).  Composes with "
+        "subsample_ratio and weight-mask CV folds (zero-weight rows "
+        "never survive); single-device fits only",
+    )
+    mvs_lambda = Param(
+        0.1, gt_eq(0.0),
+        doc="MVS regularizer: sampling scores are sqrt(grad^2 + lambda) — "
+        "larger values flatten the distribution toward uniform sampling",
+    )
+    leaf_model = Param(
+        "constant", in_array(["constant", "linear"]),
+        doc="'linear' swaps a plain DecisionTreeRegressor base learner "
+        "for models/linear_tree.py's ridge-leaf tree (arXiv:1802.05640): "
+        "piecewise-linear leaves express smooth trends in fewer boosting "
+        "rounds; 'constant' is the pre-existing behavior, bit-identical",
+    )
     replacement = Param(
         False, doc="subsample with replacement (Poisson weights)"
     )
@@ -232,7 +271,25 @@ class _GBMParams(CheckpointableParams, Estimator):
     )
 
     def _base(self) -> BaseLearner:
-        return self.base_learner or DecisionTreeRegressor()
+        base = self.base_learner or DecisionTreeRegressor()
+        if str(self.leaf_model).lower() == "linear":
+            from spark_ensemble_tpu.models.linear_tree import (
+                LinearTreeRegressor,
+            )
+
+            # swap happens HERE (not just in fit) so the fitted model's
+            # predict paths — which rebuild the base from get_params() —
+            # route the stored ridge-leaf params through the same learner
+            if type(base) is DecisionTreeRegressor:
+                base = LinearTreeRegressor(**base.get_params())
+            elif not isinstance(base, LinearTreeRegressor):
+                raise ValueError(
+                    "leaf_model='linear' needs a DecisionTreeRegressor "
+                    f"base learner (got {type(base).__name__}); pass a "
+                    "LinearTreeRegressor base directly to customize its "
+                    "leaf params"
+                )
+        return base
 
     @property
     def validation_history_(self) -> np.ndarray:
@@ -305,6 +362,88 @@ class _GBMParams(CheckpointableParams, Estimator):
             ),
         )
 
+    def _resolved_sampling(self, n: int):
+        """Host-side row-sampling plan, or None when ``sampling='none'``.
+
+        GOSS rates resolve through autotune ONLY when not hand-set (the
+        ``resolved_scan_chunk`` discipline — with autotune off they
+        resolve to the configured values, so fits stay bit-identical).
+        The plan's device scalars (``samp``) carry every rate-dependent
+        quantity as traced operands; only the pow2 ``bucket`` is static."""
+        method = str(self.sampling).lower()
+        if method == "none":
+            return None
+        from spark_ensemble_tpu.autotune import resolve as _tuned
+
+        top, other = float(self.top_rate), float(self.other_rate)
+        if method == "goss":
+            if "top_rate" not in self._param_values:
+                top = float(_tuned("goss_top_rate", top, n=n))
+            if "other_rate" not in self._param_values:
+                other = float(_tuned("goss_other_rate", other, n=n))
+            k_top = int(np.ceil(top * n))
+            k_rand = int(np.ceil(other * n))
+            amp = max(1.0 - top, 0.0) / max(other, 1e-9)  # (1-a)/b
+            lam = 0.0
+        else:  # mvs: expected sample size = (top_rate + other_rate) * n
+            k_top = 0
+            k_rand = int(np.ceil(min(top + other, 1.0) * n))
+            amp = 0.0
+            lam = float(self.mvs_lambda)
+        floor = int(_tuned("sample_bucket_floor", 256, n=n))
+        bucket = _sample_pow2_bucket(n, k_top + k_rand, floor)
+        return {
+            "method": method,
+            "bucket": bucket,
+            "samp": (
+                jnp.asarray(k_top, jnp.int32),
+                jnp.asarray(k_rand, jnp.int32),
+                jnp.asarray(amp, jnp.float32),
+                jnp.asarray(lam, jnp.float32),
+            ),
+            "top_rate": top,
+            "other_rate": other,
+            "mvs_lambda": lam,
+            "k_top": k_top,
+            "k_rand": k_rand,
+            "amp": amp,
+            "sampled_rows": min(k_top + k_rand, n),
+        }
+
+    def _check_streaming_supported(self) -> None:
+        """Streaming fits reject features whose ctx the shard sweep cannot
+        stage: the compacted row gather and the linear-leaf raw-row solves
+        both need the resident matrix."""
+        if str(self.sampling).lower() != "none":
+            raise ValueError(
+                "fit_streaming does not support gradient-based row "
+                "sampling (sampling != 'none'): the compacted gather "
+                "needs the resident row matrix"
+            )
+        if str(self.leaf_model).lower() == "linear":
+            raise ValueError(
+                "fit_streaming does not support leaf_model='linear': the "
+                "leaf ridge solve reads raw rows the shard stream does "
+                "not stage"
+            )
+
+    def _check_sampling_supported(self, plan, mesh) -> None:
+        """Shared fit-entry gates for the compacted-sampling path."""
+        if plan is None:
+            return
+        if mesh is not None:
+            raise ValueError(
+                "sampling != 'none' is single-device only for now: the "
+                "compacted row gather has no shard_map story yet (rows "
+                "would need a cross-shard gather); drop mesh= or set "
+                "sampling='none'"
+            )
+        if str(self.sample_method).lower() == "goss":
+            raise ValueError(
+                "sampling != 'none' supersedes the legacy weight-mask "
+                "sample_method='goss'; configure one of the two"
+            )
+
     def _drive_rounds(
         self,
         ckpt,
@@ -323,6 +462,7 @@ class _GBMParams(CheckpointableParams, Estimator):
         restore=None,  # (snap) -> None; rewind the carry to chunk start
         n_rows: Optional[int] = None,  # training rows (autotune shape class)
         round_cost=None,  # ops.tree.round_cost_est dict for telemetry
+        span_fields=None,  # extra round_chunk span fields (execution.py)
     ):
         """The shared round-loop driver: scan-chunked dispatch (one program
         per `scan_chunk` rounds, single-chip AND under a mesh — validation
@@ -509,6 +649,7 @@ class _GBMParams(CheckpointableParams, Estimator):
             def __init__(self):
                 self.depth = depth
                 self.telem = telem  # executor traces chunk spans through it
+                self.span_fields = span_fields
                 self.i, self.v, self.best = i, v, best
                 self.halt = False
                 self.i_disp = i  # dispatch frontier (absolute round index)
@@ -648,6 +789,91 @@ def _goss_multiplier(
     return jnp.where(score >= thr, 1.0, jnp.where(keep, 1.0 / p, 0.0))
 
 
+def _sample_pow2_bucket(n: int, k_target: int, floor: int) -> int:
+    """Host-side compaction bucket: the next power of two >= the expected
+    survivor count (floored at ``sample_bucket_floor``), clamped to n.
+    The pow2 ladder keeps the traced-program inventory O(log n) across
+    sample ratios — ratios landing in the same bucket share one compiled
+    program (pinned by analysis/contracts.py 'sampling')."""
+    target = max(1, min(int(k_target), int(n)), int(floor))
+    m = 1
+    while m < target:
+        m *= 2
+    return min(int(n), m)
+
+
+def _sample_compact(method, score, alive, key, m, samp):
+    """On-device survivor selection -> (idx[m], mult[m]): the row indices
+    gathered into the compacted buffer and their amplification weights.
+
+    Every rate-dependent quantity enters TRACED through ``samp`` =
+    ``(k_top, k_rand, amp, lam)`` — program identity depends only on the
+    static bucket ``m``, never on the configured rates (the O(1)-programs
+    contract).  Zero-weight rows (``alive`` False: masked-out CV folds,
+    subsample zeros) sort behind every candidate and can only land in the
+    buffer with multiplier 0.
+
+    GOSS (arXiv:1911.08820): the ``k_top`` largest-|grad| alive rows keep
+    multiplier 1; exactly ``k_rand`` uniform draws from the rest carry the
+    amplifier ``amp = (1-a)/b`` so the small-gradient mass stays unbiased.
+    Selection is RANK-based (stable argsort), so tied scores resolve
+    deterministically by row index.
+
+    MVS: scores ``s = sqrt(grad^2 + lam)``; the threshold ``mu`` solving
+    ``sum(min(1, s/mu)) = k_rand`` comes from an on-device bisection, rows
+    with ``s >= mu`` are kept deterministically and the rest keep with
+    probability ``s/mu`` and weight ``mu/s`` (importance-corrected).  On
+    the rare binomial overflow past ``m`` the lowest-priority random keeps
+    are dropped."""
+    k_top, k_rand, amp, lam = samp
+    n = score.shape[0]
+    u = jax.random.uniform(key, (n,))
+    if method == "goss":
+        s = jnp.where(alive, score, -jnp.inf)
+        order_s = jnp.argsort(-s)
+        rank = jnp.zeros((n,), jnp.int32).at[order_s].set(
+            jnp.arange(n, dtype=jnp.int32)
+        )
+        is_top = (rank < k_top) & alive
+        # composite priority: top rows first (by uniform tiebreak), then
+        # the random remainder ordered by its uniform draw, dead rows last
+        pri = jnp.where(is_top, 2.0 + u, jnp.where(alive, u, -1.0))
+        idx = jnp.argsort(-pri)[:m]
+        n_top = jnp.sum(is_top).astype(jnp.int32)
+        pos = jnp.arange(m, dtype=jnp.int32)
+        mult = jnp.where(
+            pos < n_top,
+            1.0,
+            jnp.where((pos < n_top + k_rand) & alive[idx], amp, 0.0),
+        )
+        return idx, mult
+    # mvs
+    s = jnp.where(alive, jnp.sqrt(score * score + lam), 0.0)
+    k_f = jnp.asarray(k_rand, jnp.float32)
+    hi0 = jnp.maximum(jnp.max(s), 1e-30)
+
+    def bisect(_, bracket):
+        lo, hi = bracket
+        mid = 0.5 * (lo + hi)
+        # count decreases in mu: too many expected keeps -> raise the floor
+        over = jnp.sum(jnp.minimum(1.0, s / mid)) >= k_f
+        return jnp.where(over, mid, lo), jnp.where(over, hi, mid)
+
+    lo, hi = jax.lax.fori_loop(0, 30, bisect, (hi0 * 1e-9, hi0))
+    mu = jnp.maximum(0.5 * (lo + hi), 1e-30)
+    keep_det = alive & (s >= mu)
+    keep_rand = alive & ~keep_det & (u * mu < s)
+    pri = jnp.where(keep_det, 2.0 + u, jnp.where(keep_rand, u, -1.0))
+    idx = jnp.argsort(-pri)[:m]
+    pri_g = pri[idx]
+    mult = jnp.where(
+        pri_g >= 2.0,
+        1.0,
+        jnp.where(pri_g >= 0.0, mu / jnp.maximum(s[idx], 1e-30), 0.0),
+    )
+    return idx, mult
+
+
 def _pseudo_residuals_and_weights(
     loss, updates, y_enc, pred, bag_w, w, axis_name=None, goss=None,
     goss_key=None,
@@ -690,7 +916,7 @@ def _make_reg_loss(loss_name, alpha_q, delta):
 
 def make_reg_round_core(
     base, loss_name, alpha_q, updates, optimized, goss, tol, max_iter,
-    ax=None,
+    ax=None, sampling="none", sample_bucket=None,
 ):
     """One regressor boosting round as a pure function of traced inputs.
 
@@ -700,7 +926,75 @@ def make_reg_round_core(
     the megabatch sweep ``vmap`` one program over candidates that differ
     only in learning rate (and in the data-borne seed/subsample/subspace
     draws).  Single source of round math for the sequential fit, the mesh
-    fit, and ``models/gbm_sweep.py``."""
+    fit, and ``models/gbm_sweep.py``.
+
+    With ``sampling`` in {'goss', 'mvs'} the returned core takes one extra
+    trailing argument ``samp`` (traced rate scalars, ``_sample_compact``)
+    and fits on a ``sample_bucket``-row compacted gather of the survivors
+    instead of the full rows — the tree fit, the newton hessian sum, and
+    the line search all run over ``sample_bucket`` rows; only the carried
+    prediction update re-routes the full matrix.  ``sampling='none'``
+    builds the EXACT pre-existing program (bit-identity pin,
+    tests/test_sampling.py)."""
+
+    if sampling != "none":
+        assert ax is None, "compacted sampling is single-device only"
+
+        def round_core_sampled(
+            ctx, X, bag_w, key, mask, pred, delta, y, w, scale, lr, samp
+        ):
+            loss = _make_reg_loss(loss_name, alpha_q, delta)
+            y_enc = loss.encode_label(y)
+            score = loss.sampling_scores(y_enc, pred[:, None])
+            alive = (w * bag_w) > 0
+            idx, mult = _sample_compact(
+                sampling, score, alive, jax.random.fold_in(key, 11),
+                sample_bucket, samp,
+            )
+            # gather the survivors into the compacted buffer; the (1-a)/b
+            # amplification folds into the bag weights so split gains and
+            # the newton normalizer stay unbiased
+            y_s, w_s, pred_s = y[idx], w[idx], pred[idx]
+            bag_s = bag_w[idx] * mult
+            labels, fit_w, bag_s = _pseudo_residuals_and_weights(
+                loss, updates, loss.encode_label(y_s), pred_s[:, None],
+                bag_s, w_s,
+            )
+            ctx_s = base.ctx_gather_rows(ctx, idx)
+            params, direction = base.fit_gathered_and_direction(
+                ctx_s, labels[:, 0], fit_w[:, 0], mask, key, X
+            )
+            dir_s = direction[idx]
+            if optimized and loss_name == "squared":
+                # closed-form quadratic minimizer over the SAMPLED rows
+                # (amplified weights keep it unbiased for the full set)
+                res_s = y_s - pred_s
+                num = jnp.sum(bag_s * dir_s * res_s)
+                den = jnp.sum(bag_s * dir_s * dir_s)
+                alpha_opt = jnp.where(
+                    den > 1e-30,
+                    jnp.clip(num / jnp.maximum(den, 1e-30), 0.0, 100.0),
+                    jnp.asarray(1.0, jnp.float32),
+                )
+            elif optimized:
+                y_enc_s = loss.encode_label(y_s)
+
+                def phi(a):
+                    return jnp.sum(
+                        bag_s
+                        * loss.loss(y_enc_s, (pred_s + a * dir_s)[:, None])
+                    )
+
+                alpha_opt = brent_minimize(
+                    phi, 0.0, 100.0, tol=tol, max_iter=max_iter
+                )
+            else:
+                alpha_opt = jnp.asarray(1.0, jnp.float32)
+            weight = jnp.where(scale > 0, lr * alpha_opt * scale, 0.0)
+            new_pred = pred + jnp.where(scale > 0, weight * direction, 0.0)
+            return params, weight, new_pred
+
+        return round_core_sampled
 
     def round_core(ctx, X, bag_w, key, mask, pred, delta, y, w, scale, lr):
         loss = _make_reg_loss(loss_name, alpha_q, delta)
@@ -764,20 +1058,24 @@ def make_reg_round_core(
 
 def make_reg_chunk_fn(
     base, loss_name, alpha_q, updates, optimized, goss, tol, max_iter,
-    huber, with_validation,
+    huber, with_validation, sampling="none", sample_bucket=None,
 ):
     """The UNJITTED single-chip chunk function: lax.scan of the round core
     over a chunk of rounds (huber's adaptive delta and the validation loss
     computed in-program, in the same per-round order as the host loop).
     The sequential fit jits it directly; the megabatch sweep jits
     ``vmap`` of it over a candidate axis — so sweep round math is the
-    sequential program by construction, not by parallel maintenance."""
+    sequential program by construction, not by parallel maintenance.
+    With ``sampling`` != 'none' the chunk takes one extra trailing
+    ``samp`` argument (see :func:`make_reg_round_core`)."""
     round_core = make_reg_round_core(
-        base, loss_name, alpha_q, updates, optimized, goss, tol, max_iter
+        base, loss_name, alpha_q, updates, optimized, goss, tol, max_iter,
+        sampling=sampling, sample_bucket=sample_bucket,
     )
 
     def chunk(ctx, X, y, w, valid_w, pred, pred_val, delta,
-              X_val_a, y_val_a, bag_ws, keys, masks, scales, lr):
+              X_val_a, y_val_a, bag_ws, keys, masks, scales, lr,
+              *samp_args):
         def body(carry, xs):
             pred, pred_val, delta = carry
             bag_w, key, mask, scale = xs
@@ -786,7 +1084,8 @@ def make_reg_chunk_fn(
                     jnp.abs(y - pred), alpha_q, weights=valid_w
                 )
             params, weight, new_pred = round_core(
-                ctx, X, bag_w, key, mask, pred, delta, y, w, scale, lr
+                ctx, X, bag_w, key, mask, pred, delta, y, w, scale, lr,
+                *samp_args,
             )
             if with_validation:
                 dir_val = base.predict_fn(params, X_val_a)
@@ -817,13 +1116,72 @@ def make_reg_chunk_fn(
 
 def make_cls_round_core(
     base, loss, dim, updates, optimized, goss, tol, max_iter,
-    ax=None, member_size=1, dim_blk=None,
+    ax=None, member_size=1, dim_blk=None, sampling="none",
+    sample_bucket=None,
 ):
     """Classifier boosting round as a pure function; see
     :func:`make_reg_round_core` for the traced-``lr`` contract (here the
-    step is ``lr * alpha_opt * scale`` over the class-dim vector)."""
+    step is ``lr * alpha_opt * scale`` over the class-dim vector) and for
+    the compacted-sampling variant (``sampling`` != 'none' adds a trailing
+    ``samp`` argument; rows rank by the l2 gradient norm over the class
+    dims and ALL dim trees fit on the same gathered buffer)."""
     dim_blk = dim if dim_blk is None else dim_blk
     k_local = dim_blk // member_size
+
+    if sampling != "none":
+        assert ax is None and member_size == 1, (
+            "compacted sampling is single-device only"
+        )
+
+        def round_core_sampled(ctx, X, y_enc, w, bag_w, key, mask, pred,
+                               alpha_ws, scale, lr, samp):
+            score = loss.sampling_scores(y_enc, pred)
+            alive = (w * bag_w) > 0
+            idx, mult = _sample_compact(
+                sampling, score, alive, jax.random.fold_in(key, 11),
+                sample_bucket, samp,
+            )
+            y_enc_s, w_s, pred_s = y_enc[idx], w[idx], pred[idx]
+            bag_s = bag_w[idx] * mult
+            labels, fit_w, bag_s = _pseudo_residuals_and_weights(
+                loss, updates, y_enc_s, pred_s, bag_s, w_s
+            )
+            ctx_s = base.ctx_gather_rows(ctx, idx)
+            params, directions = base.fit_gathered_many_and_directions(
+                ctx_s, labels, fit_w, mask, key, X
+            )
+            dirs_s = directions[idx]
+            if optimized:
+                def phi(a):
+                    return jnp.sum(
+                        bag_s
+                        * loss.loss(y_enc_s, pred_s + a[None, :] * dirs_s)
+                    )
+
+                if loss.has_hessian:
+                    gh = lambda a: loss.linesearch_grad_hess(
+                        y_enc_s, pred_s + a[None, :] * dirs_s, dirs_s,
+                        bag_s,
+                    )
+                else:
+                    gh = None
+                alpha_opt = projected_newton_box(
+                    phi, alpha_ws, max_iter=min(max_iter, 25), tol=tol,
+                    grad_hess=gh,
+                )
+            else:
+                alpha_opt = jnp.ones((dim,), jnp.float32)
+            weight = jnp.where(scale > 0, lr * alpha_opt * scale, 0.0)
+            new_pred = pred + jnp.where(
+                scale > 0, weight[None, :] * directions, 0.0
+            )
+            alpha_carry = jnp.where(
+                jnp.isfinite(alpha_opt), alpha_opt,
+                jnp.ones_like(alpha_opt),
+            )
+            return params, weight, new_pred, alpha_carry
+
+        return round_core_sampled
 
     def round_core(ctx, X, y_enc, w, bag_w, key, mask, pred,
                    alpha_ws, scale, lr):
@@ -916,22 +1274,24 @@ def make_cls_round_core(
 
 def make_cls_chunk_fn(
     base, loss, dim, updates, optimized, goss, tol, max_iter,
-    with_validation,
+    with_validation, sampling="none", sample_bucket=None,
 ):
     """UNJITTED single-chip classifier chunk (see :func:`make_reg_chunk_fn`
-    for the sequential/megabatch single-source contract)."""
+    for the sequential/megabatch single-source contract and the trailing
+    ``samp`` argument under ``sampling`` != 'none')."""
     round_core = make_cls_round_core(
-        base, loss, dim, updates, optimized, goss, tol, max_iter
+        base, loss, dim, updates, optimized, goss, tol, max_iter,
+        sampling=sampling, sample_bucket=sample_bucket,
     )
 
     def chunk(ctx, X, y_enc, w, pred, pred_val, alpha_ws, X_val_a,
-              y_enc_val_a, bag_ws, keys, masks, scales, lr):
+              y_enc_val_a, bag_ws, keys, masks, scales, lr, *samp_args):
         def body(carry, xs):
             pred, pred_val, alpha_ws = carry
             bag_w, key, mask, scale = xs
             params, weight, new_pred, alpha_ws = round_core(
                 ctx, X, y_enc, w, bag_w, key, mask, pred, alpha_ws,
-                scale, lr,
+                scale, lr, *samp_args,
             )
             if with_validation:
                 dirs_val = jax.vmap(
@@ -1165,6 +1525,22 @@ class GBMRegressor(_GBMParams):
         alpha_q = float(self.alpha)
         loss_name = self.loss.lower()
         base_key = base.config_key()
+        sample_plan = self._resolved_sampling(n)
+        self._check_sampling_supported(sample_plan, mesh)
+        samp_method = sample_plan["method"] if sample_plan else "none"
+        sample_bucket = sample_plan["bucket"] if sample_plan else None
+        samp_args = (sample_plan["samp"],) if sample_plan else ()
+        if sample_plan is not None:
+            telem.emit(
+                "sampling_config",
+                method=samp_method,
+                top_rate=sample_plan["top_rate"],
+                other_rate=sample_plan["other_rate"],
+                mvs_lambda=sample_plan["mvs_lambda"],
+                sampled_rows=sample_plan["sampled_rows"],
+                sample_bucket=sample_bucket,
+                amp=sample_plan["amp"],
+            )
 
         with_validation = X_val is not None
 
@@ -1176,6 +1552,7 @@ class GBMRegressor(_GBMParams):
             return jax.jit(make_reg_chunk_fn(
                 base, loss_name, alpha_q, updates, optimized, goss, tol,
                 max_iter, huber, with_validation,
+                sampling=samp_method, sample_bucket=sample_bucket,
             ))
 
         def build_chunk_step_mesh():
@@ -1280,6 +1657,11 @@ class GBMRegressor(_GBMParams):
             base_key,
             mesh,
         )
+        if sample_plan is not None:
+            # the sampling RATES are deliberately absent — they enter the
+            # program as traced scalars, so two ratios landing in the same
+            # pow2 bucket share one compiled program (contract: 'sampling')
+            round_key = round_key + ("sampling", samp_method, sample_bucket)
         bag_many = self._make_bag_many_fn(n, n_pad)
         if mesh is not None:
             chunk_step = cached_program(
@@ -1416,7 +1798,7 @@ class GBMRegressor(_GBMParams):
                         X_val if with_validation else val_dummy,
                         y_val if with_validation else val_dummy,
                         bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
-                        scales, jnp.float32(lr),
+                        scales, jnp.float32(lr), *samp_args,
                     )
                 )
             if with_validation:
@@ -1437,7 +1819,15 @@ class GBMRegressor(_GBMParams):
             val_history=val_history, telem=telem,
             guard=self._numeric_guard(telem),
             snapshot=snapshot, restore=restore, n_rows=n,
-            round_cost=_round_cost(base, n, d, 1),
+            round_cost=_round_cost(base, n, d, 1, sample_plan=sample_plan),
+            span_fields=(
+                {
+                    "sampling": samp_method,
+                    "sample_bucket": sample_bucket,
+                }
+                if sample_plan
+                else None
+            ),
         )
         ckpt.delete()
 
@@ -1485,6 +1875,7 @@ class GBMRegressor(_GBMParams):
         that for cheaper cross-host traffic (allclose results).  Wrap
         the call in an ``ElasticCoordinator`` to survive host
         preemptions."""
+        self._check_streaming_supported()
         from spark_ensemble_tpu.data.streaming import fit_streaming_regressor
 
         return fit_streaming_regressor(
@@ -1774,6 +2165,22 @@ class GBMClassifier(_GBMParams):
         max_iter = int(self.max_iter)
         loss_name = self.loss.lower()
         base_key = base.config_key()
+        sample_plan = self._resolved_sampling(n)
+        self._check_sampling_supported(sample_plan, mesh)
+        samp_method = sample_plan["method"] if sample_plan else "none"
+        sample_bucket = sample_plan["bucket"] if sample_plan else None
+        samp_args = (sample_plan["samp"],) if sample_plan else ()
+        if sample_plan is not None:
+            telem.emit(
+                "sampling_config",
+                method=samp_method,
+                top_rate=sample_plan["top_rate"],
+                other_rate=sample_plan["other_rate"],
+                mvs_lambda=sample_plan["mvs_lambda"],
+                sampled_rows=sample_plan["sampled_rows"],
+                sample_bucket=sample_bucket,
+                amp=sample_plan["amp"],
+            )
 
         y_enc = loss.encode_label(y)
 
@@ -1800,6 +2207,7 @@ class GBMClassifier(_GBMParams):
             return jax.jit(make_cls_chunk_fn(
                 base, loss, dim, updates, optimized, goss, tol, max_iter,
                 with_validation,
+                sampling=samp_method, sample_bucket=sample_bucket,
             ))
 
         def build_chunk_step_mesh():
@@ -1904,6 +2312,9 @@ class GBMClassifier(_GBMParams):
             base_key,
             mesh,
         )
+        if sample_plan is not None:
+            # rates traced, bucket static — see the regressor's note
+            round_key = round_key + ("sampling", samp_method, sample_bucket)
         bag_many = self._make_bag_many_fn(n, n_pad)
         if mesh is not None:
             chunk_step = cached_program(
@@ -2043,7 +2454,7 @@ class GBMClassifier(_GBMParams):
                         X_val if with_validation else val_dummy,
                         y_enc_val if with_validation else val_dummy,
                         bag_many(bag_keys[sl]), bag_keys[sl], masks[sl],
-                        scales, jnp.float32(lr),
+                        scales, jnp.float32(lr), *samp_args,
                     )
                 )
             if with_validation:
@@ -2058,7 +2469,10 @@ class GBMClassifier(_GBMParams):
             pred, pred_val, alpha_ws = snap
 
         telem.phase_mark("setup")
-        if telem.enabled and telem.phases_enabled() and mesh is None:
+        if (
+            telem.enabled and telem.phases_enabled() and mesh is None
+            and sample_plan is None
+        ):
             _probe_classifier_phases(
                 telem, loss, updates, base, ctx, X, y_enc, w,
                 bag_many(bag_keys[:1])[0], bag_keys[0], masks[0], pred,
@@ -2071,7 +2485,17 @@ class GBMClassifier(_GBMParams):
             val_history=val_history, telem=telem,
             guard=self._numeric_guard(telem),
             snapshot=snapshot, restore=restore, n_rows=n,
-            round_cost=_round_cost(base, n, d, dim),
+            round_cost=_round_cost(
+                base, n, d, dim, sample_plan=sample_plan
+            ),
+            span_fields=(
+                {
+                    "sampling": samp_method,
+                    "sample_bucket": sample_bucket,
+                }
+                if sample_plan
+                else None
+            ),
         )
         ckpt.delete()
 
@@ -2111,6 +2535,7 @@ class GBMClassifier(_GBMParams):
         """Out-of-core fit over a sealed ``ShardStore`` (data/shards.py);
         see ``GBMRegressor.fit_streaming`` — including the ``mesh``/
         ``reduce`` distributed-sweep knobs."""
+        self._check_streaming_supported()
         from spark_ensemble_tpu.data.streaming import fit_streaming_classifier
 
         return fit_streaming_classifier(

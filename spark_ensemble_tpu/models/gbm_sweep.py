@@ -139,6 +139,16 @@ def sweep_unsupported_reason(estimator, mesh=None) -> Optional[str]:
             f"on_nonfinite={estimator.on_nonfinite!r} needs the sequential "
             "recovery driver (sweeps support 'raise'/'off' only)"
         )
+    if str(estimator.sampling).lower() != "none":
+        return (
+            f"sampling={estimator.sampling!r} compacts rows per round "
+            "(models/gbm.py GOSS/MVS) and has no megabatch round core yet"
+        )
+    if str(estimator.leaf_model).lower() == "linear":
+        return (
+            "leaf_model='linear' fits ridge leaves outside the fused "
+            "forest kernel and has no megabatch round core yet"
+        )
     return None
 
 

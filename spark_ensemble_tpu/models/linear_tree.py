@@ -35,6 +35,7 @@ from spark_ensemble_tpu.models.base import (
 from spark_ensemble_tpu.models.linear import _apply_mask, _feature_stats
 from spark_ensemble_tpu.models.tree import DecisionTreeRegressor
 from spark_ensemble_tpu.ops.collective import preduce
+from spark_ensemble_tpu.ops.linesearch import chol_solve_psd
 from spark_ensemble_tpu.ops.tree import (
     _F32_MAX,
     Tree,
@@ -72,6 +73,10 @@ class LinearTreeRegressor(DecisionTreeRegressor):
         ctx = super().make_fit_ctx(X, num_classes)
         ctx["X"] = as_f32(X)  # raw features for the leaf models
         return ctx
+
+    def ctx_gather_rows(self, ctx, idx):
+        """Leaf ridge solves read the raw rows too — gather both matrices."""
+        return {**super().ctx_gather_rows(ctx, idx), "X": ctx["X"][idx]}
 
     def ctx_specs(self, ctx, data_axis):
         from jax.sharding import PartitionSpec as P
@@ -128,10 +133,12 @@ class LinearTreeRegressor(DecisionTreeRegressor):
                 ]
             )
         )
+        # hand-rolled SPD solve (ops/linesearch.py): LAPACK's batched
+        # Cholesky is not bit-stable under vmap, and GBM's piecewise-linear
+        # leaves (leaf_model="linear") run this solve inside vmapped /
+        # scan-chunked round programs where lane-independence is load-bearing
         beta = jax.vmap(
-            lambda Ai, bi: jax.scipy.linalg.solve(
-                Ai + ridge, bi, assume_a="pos"
-            )
+            lambda Ai, bi: chol_solve_psd(Ai + ridge, bi)
         )(A, b)  # [leaves, d+1]
         # underdetermined leaves keep the constant tree value; the support
         # bar is in EFFECTIVE rows (weight / mean positive weight), so a

@@ -195,6 +195,11 @@ class _TreeLearner(BaseLearner):
         """Leaf-value selection -> the member's scalar prediction."""
         raise NotImplementedError
 
+    def ctx_gather_rows(self, ctx, idx):
+        """Row-compact the binned matrix only; thresholds/num_classes are
+        replicated (gradient-based row sampling, models/gbm.py)."""
+        return {**ctx, "Xb": ctx["Xb"][idx]}
+
     def ctx_specs(self, ctx, data_axis):
         from jax.sharding import PartitionSpec as P
 

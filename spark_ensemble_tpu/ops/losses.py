@@ -68,6 +68,15 @@ class GBMLoss:
     def hessian(self, label: jax.Array, prediction: jax.Array) -> jax.Array:
         raise NotImplementedError(f"{self.name} has no hessian")
 
+    def sampling_scores(self, label, prediction):
+        """Per-row gradient magnitude ``[n]`` driving gradient-based row
+        sampling (GOSS/MVS, models/gbm.py): the l2 norm of the negative
+        gradient over the class dims.  One definition so the regressor,
+        the classifier, and the legacy weight-mask GOSS rank rows by the
+        exact same statistic."""
+        g = self.negative_gradient(label, prediction)
+        return jnp.sqrt(jnp.sum(g * g, axis=-1))
+
     def linesearch_grad_hess(self, label, prediction, directions, bag_w):
         """Closed-form ``(grad[dim], hess[dim, dim])`` of the step-size
         objective ``a -> sum_i bag_w_i * L(label_i, pred_i + a∘dir_i)``,

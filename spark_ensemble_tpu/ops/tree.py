@@ -1080,6 +1080,7 @@ def resolved_forest_tier(
 def round_cost_est(
     n: int, d: int, k: int, M: int, max_depth: int, max_bins: int,
     hist: str = "auto", hist_precision: str = "highest",
+    sampled_rows: int = None,
 ) -> dict:
     """Static per-round cost estimate from shapes + the resolved tier.
 
@@ -1097,6 +1098,13 @@ def round_cost_est(
     peak_flops)`` is comparable between tiers.  Feeds FitTelemetry round
     events (models/gbm.py) and the bench hist-tier A/B leg.
 
+    ``sampled_rows`` (the compaction bucket of a GOSS/MVS-sampled round,
+    models/gbm.py) re-models the histogram costs at the bucket size —
+    including re-resolving the tier, since fewer rows can fit back under
+    the matmul one-hot budget — plus ONE full-row feature pass (score +
+    gather + direction re-route), and adds ``hbm_saved_est``: the
+    predicted per-round HBM saving the ledger checks against measurement.
+
     The live operator plane cross-checks this model against XLA's own
     ``cost_analysis()`` for the round program
     (``xla_vs_analytic_flops_ratio`` in round_end events and bench
@@ -1107,40 +1115,64 @@ def round_cost_est(
     """
     B = max_bins
     C = 1 + k
-    tier = resolved_forest_tier(
-        hist, hist_precision, n, d, B, M=M, C=C, max_depth=max_depth
-    )
     from spark_ensemble_tpu.ops.binning import pack_width
 
-    bits = pack_width(B) if tier == "fused" else 0
-    lanes = 32 // bits if bits else 1
-    words = -(-d // lanes)
+    def cost_at(n_rows: int):
+        """(tier, pack_bits, hbm, flops) at a given row count — called a
+        second time at the compaction bucket for sampled rounds, where the
+        tier itself may differ (fewer rows can fit back under the matmul
+        one-hot budget)."""
+        tier = resolved_forest_tier(
+            hist, hist_precision, n_rows, d, B, M=M, C=C,
+            max_depth=max_depth,
+        )
+        bits = pack_width(B) if tier == "fused" else 0
+        lanes = 32 // bits if bits else 1
+        words = -(-d // lanes)
 
-    def level_bytes(nodes: int, leaf: bool) -> int:
-        flat = {
-            # scatter: bin matrix + broadcast statistic writes per channel
-            "scatter": n * d * (C + 1) * 4,
-            # stream: uint8 bin matrix (B <= 256) + node ids + channels
-            "stream": n * ((d if B <= 256 else d * 4) + M * 4 + M * C * 4),
-            # pallas histogram kernel: i32 bin matrix + node ids + channels
-            "pallas": n * (d * 4 + M * 4 + M * C * 4),
-            # fused: bit-packed words + node ids + channels
-            "fused": n * (words * 4 + M * 4 + M * C * 4),
-        }
-        if tier != "matmul":
-            return flat[tier]
-        if leaf:
-            # leaf einsum: [n, M, leaves] one-hot + value channels
-            return n * M * (nodes + C) * 4
-        # dense matmul: [n, d*B] bin one-hot + [n, M*nodes*C] stat operand
-        return n * (d * B * 4 + M * nodes * C * 4)
+        def level_bytes(nodes: int, leaf: bool) -> int:
+            flat = {
+                # scatter: bin matrix + broadcast statistic writes per
+                # channel
+                "scatter": n_rows * d * (C + 1) * 4,
+                # stream: uint8 bin matrix (B <= 256) + node ids + channels
+                "stream": n_rows * (
+                    (d if B <= 256 else d * 4) + M * 4 + M * C * 4
+                ),
+                # pallas histogram kernel: i32 bin matrix + node ids +
+                # channels
+                "pallas": n_rows * (d * 4 + M * 4 + M * C * 4),
+                # fused: bit-packed words + node ids + channels
+                "fused": n_rows * (words * 4 + M * 4 + M * C * 4),
+            }
+            if tier != "matmul":
+                return flat[tier]
+            if leaf:
+                # leaf einsum: [n, M, leaves] one-hot + value channels
+                return n_rows * M * (nodes + C) * 4
+            # dense matmul: [n, d*B] bin one-hot + [n, M*nodes*C] stat
+            # operand
+            return n_rows * (d * B * 4 + M * nodes * C * 4)
 
-    hbm = sum(
-        level_bytes(2**level, False) for level in range(max_depth)
-    ) + level_bytes(2**max_depth, True)
-    flops = sum(
-        2.0 * n * (M * 2**level * C) * (d * B) for level in range(max_depth)
-    ) + 2.0 * n * M * 2**max_depth * C
+        hbm = sum(
+            level_bytes(2**level, False) for level in range(max_depth)
+        ) + level_bytes(2**max_depth, True)
+        flops = sum(
+            2.0 * n_rows * (M * 2**level * C) * (d * B)
+            for level in range(max_depth)
+        ) + 2.0 * n_rows * M * 2**max_depth * C
+        return tier, bits, hbm, flops
+
+    tier, bits, hbm, flops = cost_at(n)
+    saved = None
+    if sampled_rows is not None and int(sampled_rows) < n:
+        hbm_full = hbm
+        # the compacted gather itself still reads the full-row feature
+        # operand once (score + gather + full-row direction re-route):
+        # charge one full-n row pass so the saving claim stays honest
+        tier, bits, hbm, flops = cost_at(int(sampled_rows))
+        hbm += n * d * 4
+        saved = max(int(hbm_full) - int(hbm), 0)
     peak = 197e12 if jax.default_backend() == "tpu" else 1e12
     # nominal HBM bandwidth paired with peak_flops: the roofline's other
     # axis, so telemetry can model round time as max(flops/peak,
@@ -1148,7 +1180,7 @@ def round_cost_est(
     # duration (v5p-class HBM; CPU placeholder mirrors the peak_flops
     # convention above)
     bw = 1.23e12 if jax.default_backend() == "tpu" else 5e10
-    return {
+    out = {
         "hist_tier": tier,
         "pack_bits": bits,
         "hbm_bytes_est": int(hbm),
@@ -1156,6 +1188,9 @@ def round_cost_est(
         "peak_flops": float(peak),
         "hbm_bw_est": float(bw),
     }
+    if saved is not None:
+        out["hbm_saved_est"] = int(saved)
+    return out
 
 
 @functools.partial(

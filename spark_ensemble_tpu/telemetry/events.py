@@ -578,7 +578,8 @@ class FitTelemetry:
             self._ledger_mem[dev] = cur
         cost_fields: Dict[str, Any] = {}
         if round_cost:
-            for key in ("hist_tier", "pack_bits", "hbm_bytes_est"):
+            for key in ("hist_tier", "pack_bits", "hbm_bytes_est",
+                        "sampled_rows", "sample_bucket", "hbm_saved_est"):
                 if key in round_cost:
                     cost_fields[key] = round_cost[key]
             flops = round_cost.get("flops_est")

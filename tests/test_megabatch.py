@@ -156,6 +156,47 @@ def test_sweep_rejects_structural_mix_and_unsupported():
         fit_sweep([a.copy(checkpoint_dir="/tmp/ck")], X, y)
 
 
+def test_sweep_routes_sampling_and_linear_leaves_sequential():
+    """Gradient-based row sampling and piecewise-linear leaves have no
+    megabatch round core: the reason gate must route them to the
+    sequential loop under megabatch='auto' and raise under 'on'."""
+    X, y = _data()
+    a = GBMRegressor(num_base_learners=2)
+    assert "sampling" in sweep_unsupported_reason(a.copy(sampling="goss"))
+    assert "sampling" in sweep_unsupported_reason(a.copy(sampling="mvs"))
+    assert "linear" in sweep_unsupported_reason(a.copy(leaf_model="linear"))
+    assert sweep_unsupported_reason(
+        a.copy(sampling="none", leaf_model="constant")
+    ) is None
+    grid = ParamGridBuilder().add_grid("learning_rate", [0.1, 0.3]).build()
+    kw = dict(
+        estimator=a.copy(sampling="goss"),
+        estimator_param_maps=grid,
+        evaluator=RegressionEvaluator(metric="rmse"),
+        seed=0,
+    )
+    with pytest.raises(ValueError, match="sampling"):
+        TrainValidationSplit(megabatch="on", **kw).fit(X, y)
+
+
+@pytest.mark.slow
+def test_sweep_auto_falls_back_sequential_for_sampled_fits():
+    """megabatch='auto' on a sampled grid must land byte-for-byte on the
+    sequential loop's answer (the fallback IS the sequential loop)."""
+    X, y = _data()
+    grid = ParamGridBuilder().add_grid("learning_rate", [0.1, 0.3]).build()
+    kw = dict(
+        estimator=GBMRegressor(num_base_learners=2, sampling="goss"),
+        estimator_param_maps=grid,
+        evaluator=RegressionEvaluator(metric="rmse"),
+        seed=0,
+    )
+    seq = TrainValidationSplit(megabatch="off", **kw).fit(X, y)
+    auto = TrainValidationSplit(megabatch="auto", **kw).fit(X, y)
+    assert seq.validation_metrics == auto.validation_metrics
+    assert seq.best_index == auto.best_index
+
+
 def test_chol_solve_psd_lane_independent_and_accurate():
     """The hand-rolled Cholesky solve exists because LAPACK's batched
     kernel under vmap reorders arithmetic per lane.  Pin the property the

@@ -1,0 +1,418 @@
+"""Gradient-based row sampling (docs/sampling.md): GOSS/MVS selection
+statistics, bit-identity of the untouched path, composition with bagging
+/ CV weight masks / the pipelined executor, the O(1)-programs bucket
+ladder, piecewise-linear leaves, and kill-and-resume on a sampled fit.
+
+The load-bearing pins:
+
+- ``sampling="none"`` + ``leaf_model="constant"`` is BIT-identical to a
+  default fit — the sampling stage must be unreachable, not merely
+  inert, on the default path.
+- two GOSS rate pairs landing in the same pow2 bucket re-enter the SAME
+  compiled program set (rates are traced operands; the graftlint
+  ``sampling`` contract pins the same thing at tier 2).
+- a sampled fit is deterministic, composes with ``subsample_ratio`` and
+  zero-weight rows (dead rows never survive compaction), and replays
+  bit-identically through checkpoint resume.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import spark_ensemble_tpu as se
+from spark_ensemble_tpu import autotune
+from spark_ensemble_tpu.models.base import observe_program_calls
+from spark_ensemble_tpu.models.gbm import (
+    GBMClassifier,
+    GBMRegressor,
+    _sample_compact,
+    _sample_pow2_bucket,
+)
+from spark_ensemble_tpu.robustness import chaos
+from spark_ensemble_tpu.robustness.chaos import ChaosController, ChaosPreemption
+from spark_ensemble_tpu.telemetry import record_fits
+
+pytestmark = pytest.mark.slow
+
+
+def _data(n=400, d=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X @ rng.randn(d) + 0.1 * rng.randn(n)).astype(np.float32)
+    return X, y
+
+
+def _cls_data(n=400, d=6, seed=0):
+    X, y = _data(n, d, seed)
+    return X, (y > np.median(y)).astype(np.float32)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_chaos():
+    yield
+    chaos.install(None)
+
+
+# ---------------------------------------------------------------------------
+# selection helper statistics
+# ---------------------------------------------------------------------------
+
+
+def test_pow2_bucket_ladder():
+    assert _sample_pow2_bucket(1000, 300, 256) == 512
+    assert _sample_pow2_bucket(1000, 100, 256) == 256  # floored
+    assert _sample_pow2_bucket(1000, 3, 1) == 4
+    assert _sample_pow2_bucket(100, 300, 256) == 100  # clamped to n
+    # the same bucket serves a band of rates: O(1) traced programs
+    assert _sample_pow2_bucket(1000, 260, 256) == _sample_pow2_bucket(
+        1000, 510, 256
+    )
+
+
+def _goss_samp(k_top, k_rand, amp):
+    return (
+        jnp.int32(k_top), jnp.int32(k_rand),
+        jnp.float32(amp), jnp.float32(0.0),
+    )
+
+
+def test_goss_selection_exact_counts_and_top_rows():
+    n, k_top, k_rand = 1000, 200, 100
+    amp = (1.0 - 0.2) / 0.1
+    rng = np.random.default_rng(0)
+    score = jnp.asarray(np.abs(rng.normal(size=n)).astype(np.float32))
+    alive = jnp.ones(n, bool)
+    m = _sample_pow2_bucket(n, k_top + k_rand, 256)
+    idx, mult = _sample_compact(
+        "goss", score, alive, jax.random.PRNGKey(0), m,
+        _goss_samp(k_top, k_rand, amp),
+    )
+    idx, mult = np.asarray(idx), np.asarray(mult)
+    s = np.asarray(score)
+    assert int(np.sum(mult == 1.0)) == k_top
+    assert int(np.sum(np.isclose(mult, amp))) == k_rand
+    assert int(np.sum(mult == 0.0)) == m - k_top - k_rand
+    # the unit-weight rows ARE the |grad| top set, gathered rank-first
+    assert set(idx[:k_top].tolist()) == set(np.argsort(-s)[:k_top].tolist())
+    assert len(set(idx.tolist())) == m  # no duplicate gathers
+
+
+def test_goss_amplification_unbiased():
+    """E[amplified small-grad mass] == the true non-top mass (the (1-a)/b
+    reweighting that keeps split gains unbiased, arXiv 1911.08820)."""
+    n, k_top, k_rand = 600, 120, 60
+    amp = (1.0 - 0.2) / 0.1
+    rng = np.random.default_rng(1)
+    s = np.abs(rng.normal(size=n)).astype(np.float32)
+    score, alive = jnp.asarray(s), jnp.ones(n, bool)
+    m = _sample_pow2_bucket(n, k_top + k_rand, 64)
+    top = np.argsort(-s)[:k_top]
+    rest_true = float(np.sum(s) - np.sum(s[top]))
+    est = []
+    for i in range(80):
+        idx, mult = _sample_compact(
+            "goss", score, alive, jax.random.PRNGKey(i), m,
+            _goss_samp(k_top, k_rand, amp),
+        )
+        idx, mult = np.asarray(idx), np.asarray(mult)
+        est.append(float(np.sum(s[idx] * mult) - np.sum(s[top])))
+    assert abs(np.mean(est) - rest_true) / rest_true < 0.1
+
+
+def test_mvs_expected_size_and_mass():
+    """MVS keeps ~k rows in expectation and its importance weights
+    preserve the total sampling-probability mass (unbiasedness)."""
+    n, k, lam = 600, 200, 0.1
+    rng = np.random.default_rng(2)
+    g = np.abs(rng.normal(size=n)).astype(np.float32)
+    score, alive = jnp.asarray(g), jnp.ones(n, bool)
+    m = _sample_pow2_bucket(n, k, 64)
+    samp = (jnp.int32(0), jnp.int32(k), jnp.float32(0.0), jnp.float32(lam))
+    s_true = np.sqrt(g * g + lam)
+    kept, mass = [], []
+    for i in range(80):
+        idx, mult = _sample_compact(
+            "mvs", score, alive, jax.random.PRNGKey(i), m, samp
+        )
+        idx, mult = np.asarray(idx), np.asarray(mult)
+        kept.append(int(np.sum(mult > 0)))
+        mass.append(float(np.sum(s_true[idx] * mult)))
+    assert abs(np.mean(kept) - k) < 0.1 * k
+    total = float(np.sum(s_true))
+    assert abs(np.mean(mass) - total) / total < 0.05
+
+
+@pytest.mark.parametrize("method", ["goss", "mvs"])
+def test_dead_rows_never_sampled(method):
+    """Rows masked out by bagging or a CV weight fold (w * bag_w == 0)
+    must never reach a fitted tree with nonzero weight."""
+    n = 500
+    rng = np.random.default_rng(3)
+    score = jnp.asarray(np.abs(rng.normal(size=n)).astype(np.float32))
+    alive_np = rng.random(n) > 0.5
+    samp = (
+        (jnp.int32(100), jnp.int32(50), jnp.float32(8.0), jnp.float32(0.0))
+        if method == "goss"
+        else (jnp.int32(0), jnp.int32(150), jnp.float32(0.0), jnp.float32(0.1))
+    )
+    idx, mult = _sample_compact(
+        method, score, jnp.asarray(alive_np), jax.random.PRNGKey(0), 256, samp
+    )
+    idx, mult = np.asarray(idx), np.asarray(mult)
+    assert np.all(mult[~alive_np[idx]] == 0.0)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity of the untouched path
+# ---------------------------------------------------------------------------
+
+
+def test_none_constant_bit_identical_to_default():
+    X, y = _data()
+    p_default = np.asarray(
+        GBMRegressor(num_base_learners=4, seed=7).fit(X, y).predict(X)
+    )
+    p_explicit = np.asarray(
+        GBMRegressor(
+            num_base_learners=4, seed=7,
+            sampling="none", leaf_model="constant",
+        ).fit(X, y).predict(X)
+    )
+    assert np.array_equal(p_default, p_explicit)
+    Xc, yc = _cls_data()
+    r_default = np.asarray(
+        GBMClassifier(num_base_learners=4, seed=7).fit(Xc, yc).predict_raw(Xc)
+    )
+    r_explicit = np.asarray(
+        GBMClassifier(
+            num_base_learners=4, seed=7,
+            sampling="none", leaf_model="constant",
+        ).fit(Xc, yc).predict_raw(Xc)
+    )
+    assert np.array_equal(r_default, r_explicit)
+
+
+# ---------------------------------------------------------------------------
+# sampled fits: determinism and composition
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("method", ["goss", "mvs"])
+def test_sampled_fit_deterministic(method):
+    X, y = _data()
+    kw = dict(num_base_learners=4, seed=5, sampling=method)
+    p1 = np.asarray(GBMRegressor(**kw).fit(X, y).predict(X))
+    p2 = np.asarray(GBMRegressor(**kw).fit(X, y).predict(X))
+    assert np.all(np.isfinite(p1))
+    assert np.array_equal(p1, p2)
+
+
+def test_sampling_composes_with_subsample_and_weights():
+    """GOSS on top of row bagging and a zero-weight mask: runs, finite,
+    deterministic — and the sampled fit only ever sees alive rows (the
+    helper-level pin is test_dead_rows_never_sampled)."""
+    X, y = _data()
+    w = np.ones(len(y), np.float32)
+    w[::3] = 0.0  # a CV-style weight-mask fold
+    kw = dict(
+        num_base_learners=4, seed=5, sampling="goss", subsample_ratio=0.7
+    )
+    p1 = np.asarray(GBMRegressor(**kw).fit(X, y, sample_weight=w).predict(X))
+    p2 = np.asarray(GBMRegressor(**kw).fit(X, y, sample_weight=w).predict(X))
+    assert np.all(np.isfinite(p1))
+    assert np.array_equal(p1, p2)
+
+
+def test_sampled_fit_pipeline_bit_identical(monkeypatch):
+    """SE_TPU_PIPELINE=1 speculation over a sampled fit commits the same
+    model as the synchronous path (absolute round keys; the gathered
+    compaction is inside the chunk program, invisible to the executor)."""
+    X, y = _data()
+    kw = dict(num_base_learners=6, scan_chunk=2, seed=5, sampling="goss")
+    monkeypatch.setenv("SE_TPU_PIPELINE", "0")
+    p_sync = np.asarray(GBMRegressor(**kw).fit(X, y).predict(X))
+    monkeypatch.setenv("SE_TPU_PIPELINE", "1")
+    p_pipe = np.asarray(GBMRegressor(**kw).fit(X, y).predict(X))
+    assert np.array_equal(p_sync, p_pipe)
+
+
+@pytest.mark.parametrize("method", ["goss", "mvs"])
+def test_sampled_classifier_runs(method):
+    Xc, yc = _cls_data()
+    kw = dict(num_base_learners=4, seed=5, sampling=method)
+    r1 = np.asarray(GBMClassifier(**kw).fit(Xc, yc).predict_raw(Xc))
+    r2 = np.asarray(GBMClassifier(**kw).fit(Xc, yc).predict_raw(Xc))
+    assert np.all(np.isfinite(r1))
+    assert np.array_equal(r1, r2)
+
+
+def test_sampling_rejects_legacy_goss_mix_and_streaming():
+    X, y = _data()
+    with pytest.raises(ValueError, match="sample_method"):
+        GBMRegressor(sampling="goss", sample_method="goss").fit(X, y)
+    with pytest.raises(ValueError, match="sampling"):
+        GBMRegressor(sampling="goss").fit_streaming(X, y)
+    with pytest.raises(ValueError, match="linear"):
+        GBMRegressor(leaf_model="linear").fit_streaming(X, y)
+
+
+# ---------------------------------------------------------------------------
+# the O(1)-programs bucket ladder
+# ---------------------------------------------------------------------------
+
+
+class _Recorder:
+    def __init__(self):
+        self.keys = set()
+
+    def __call__(self, tag, sig, fn, args, kwargs):
+        self.keys.add((tag, sig))
+
+
+def test_same_bucket_rates_share_program_set():
+    """Two GOSS rate pairs whose targets land in one pow2 bucket dispatch
+    the SAME compiled programs: the rate scalars ride as traced operands,
+    never as trace constants (the graftlint ``sampling`` contract)."""
+    X, y = _data(n=512)
+    sets = {}
+    for rates in ((0.2, 0.1), (0.25, 0.12)):
+        rec = _Recorder()
+        with autotune.override(sample_bucket_floor=64):
+            with observe_program_calls(rec):
+                GBMRegressor(
+                    num_base_learners=3, seed=0, sampling="goss",
+                    top_rate=rates[0], other_rate=rates[1],
+                ).fit(X, y)
+        sets[rates] = frozenset(rec.keys)
+    (r_a, s_a), (r_b, s_b) = sorted(sets.items())
+    assert s_a == s_b, (
+        f"program set varies with rates: {r_a} vs {r_b} differ by "
+        f"{sorted(t for t, _ in s_a.symmetric_difference(s_b))}"
+    )
+
+
+def test_fused_tier_sampled_no_new_programs():
+    """The fused-histogram tier re-enters its own program set under
+    sampling — the gathered buffer is just a smaller row dim, not a new
+    code path."""
+    X, y = _data(n=512)
+    sets = {}
+    for rates in ((0.2, 0.1), (0.25, 0.12)):
+        rec = _Recorder()
+        with autotune.override(sample_bucket_floor=64):
+            with observe_program_calls(rec):
+                GBMRegressor(
+                    base_learner=se.DecisionTreeRegressor(hist="fused"),
+                    num_base_learners=3, seed=0, sampling="goss",
+                    top_rate=rates[0], other_rate=rates[1],
+                ).fit(X, y)
+        sets[rates] = frozenset(rec.keys)
+    (_, s_a), (_, s_b) = sorted(sets.items())
+    assert s_a == s_b
+
+
+# ---------------------------------------------------------------------------
+# piecewise-linear leaves
+# ---------------------------------------------------------------------------
+
+
+def test_linear_leaves_beat_constant_on_piecewise_linear_target():
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 4).astype(np.float32)
+    y = np.where(X[:, 0] > 0, 3.0 * X[:, 1], -2.0 * X[:, 2]).astype(
+        np.float32
+    )
+    kw = dict(num_base_learners=8, seed=3)
+    mse_const = float(np.mean((np.asarray(
+        GBMRegressor(leaf_model="constant", **kw).fit(X, y).predict(X)
+    ) - y) ** 2))
+    mse_lin = float(np.mean((np.asarray(
+        GBMRegressor(leaf_model="linear", **kw).fit(X, y).predict(X)
+    ) - y) ** 2))
+    assert mse_lin < 0.5 * mse_const
+
+
+def test_linear_leaves_deterministic_and_compose_with_sampling():
+    X, y = _data()
+    kw = dict(num_base_learners=4, seed=5, leaf_model="linear")
+    p1 = np.asarray(GBMRegressor(**kw).fit(X, y).predict(X))
+    p2 = np.asarray(GBMRegressor(**kw).fit(X, y).predict(X))
+    assert np.all(np.isfinite(p1)) and np.array_equal(p1, p2)
+    pg = np.asarray(
+        GBMRegressor(sampling="goss", **kw).fit(X, y).predict(X)
+    )
+    assert np.all(np.isfinite(pg))
+
+
+def test_linear_leaf_rejects_foreign_base_learner():
+    X, y = _data()
+    with pytest.raises(ValueError, match="linear"):
+        GBMRegressor(
+            leaf_model="linear", base_learner=se.LinearRegression()
+        ).fit(X, y)
+
+
+# ---------------------------------------------------------------------------
+# telemetry + kill-and-resume
+# ---------------------------------------------------------------------------
+
+
+def test_sampling_config_event_and_round_fields():
+    X, y = _data()
+    with record_fits() as rec:
+        GBMRegressor(num_base_learners=3, seed=0, sampling="goss").fit(X, y)
+    cfgs = [e for e in rec.events if e["event"] == "sampling_config"]
+    assert len(cfgs) == 1
+    cfg = cfgs[0]
+    assert cfg["method"] == "goss"
+    assert cfg["sample_bucket"] >= cfg["sampled_rows"] > 0
+    ends = [e for e in rec.events if e["event"] == "round_end"]
+    assert ends and all(
+        e["sample_bucket"] == cfg["sample_bucket"]
+        and e["sampled_rows"] == cfg["sampled_rows"]
+        and e["hbm_saved_est"] >= 0
+        for e in ends
+    )
+
+
+def test_sampled_fit_recovers_from_nan_round():
+    """A poisoned gradient inside a sampled round (chaos nan_grad) is
+    skipped by the guard exactly like on the full-row path — the
+    compacted buffer must not leak NaNs past the recovery rewind."""
+    X, y = _data()
+    ctl = ChaosController(
+        seed=11, rate=1.0, faults=("nan_grad",), budgets={"nan_grad": 1}
+    )
+    chaos.install(ctl)
+    m = GBMRegressor(
+        num_base_learners=5, scan_chunk=2, seed=5,
+        sampling="goss", on_nonfinite="skip_round",
+    ).fit(X, y)
+    assert ctl.fired
+    assert np.all(np.isfinite(np.asarray(m.predict(X))))
+
+
+def test_sampled_kill_and_resume_matches_uninterrupted(tmp_path):
+    X, y = _data()
+
+    def est(ckdir):
+        kw = dict(
+            num_base_learners=6, scan_chunk=2, seed=5, sampling="goss"
+        )
+        if ckdir:
+            kw.update(checkpoint_dir=ckdir, checkpoint_interval=1)
+        return GBMRegressor(**kw)
+
+    p_ref = np.asarray(est(None).fit(X, y).predict(X))
+    interrupted = est(str(tmp_path / "ck"))
+    chaos.install(ChaosController(
+        seed=3, rate=1.0, faults=("preempt",), budgets={"preempt": 1}
+    ))
+    with pytest.raises(ChaosPreemption):
+        interrupted.fit(X, y)
+    chaos.install(None)
+    m = interrupted.fit(X, y)  # resumes; sampling keys replay by round
+    assert np.array_equal(np.asarray(m.predict(X)), p_ref)

@@ -94,6 +94,14 @@ METRICS: Dict[str, Any] = {
     # stopped batching.  Ratio of two noisy walls on shared CI runners:
     # wide rel floor.
     "sweep_speedup":              ("higher", 0.30, 0.0),
+    # gradient-based sampling Pareto leg (docs/sampling.md): wall-clock
+    # to the target accuracy at sampling='none' over the best sampled
+    # method (GOSS/MVS), warm programs both legs.  A collapse toward 1.0
+    # means the compacted row buffer quietly stopped paying for its
+    # full-row score/gather overhead.  Time-to-accuracy couples two
+    # noisy measurements (per-round wall AND a take(k) accuracy scan):
+    # wide rel floor.
+    "sampling_speedup":           ("higher", 0.30, 0.0),
 }
 
 

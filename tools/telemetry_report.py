@@ -141,6 +141,44 @@ def round_cost_line(fit_events: List[dict]) -> Optional[str]:
     return "  ".join(parts)
 
 
+def sampling_line(fit_events: List[dict]) -> Optional[str]:
+    """Gradient-based row sampling summary: the method and rates from the
+    fit's ``sampling_config`` event plus the compacted bucket and the
+    modeled per-round HBM saving the round_end cost fields carry
+    (models/gbm.py GOSS/MVS).  Fits with ``sampling='none'`` emit no
+    config event and get no line."""
+    cfg = next(
+        (e for e in fit_events if e.get("event") == "sampling_config"), None
+    )
+    if cfg is None:
+        return None
+    parts = [f"sampling: {cfg.get('method')}"]
+    if cfg.get("method") == "mvs":
+        parts.append(f"lambda {float(cfg.get('mvs_lambda', 0.0)):g}")
+    else:
+        parts.append(
+            f"rates {float(cfg.get('top_rate', 0.0)):g}"
+            f"/{float(cfg.get('other_rate', 0.0)):g}"
+        )
+    rows = cfg.get("sampled_rows")
+    bucket = cfg.get("sample_bucket")
+    if rows is not None and bucket is not None:
+        parts.append(f"rows {int(rows)} -> bucket {int(bucket)}")
+    ev = next(
+        (
+            e
+            for e in fit_events
+            if e.get("event") == "round_end" and "hbm_saved_est" in e
+        ),
+        None,
+    )
+    if ev is not None:
+        parts.append(
+            f"hbm saved/round {float(ev['hbm_saved_est']) / 2**20:.2f} MiB"
+        )
+    return "  ".join(parts)
+
+
 def cost_model_line(fit_events: List[dict]) -> Optional[str]:
     """Measured-vs-estimated ledger: median modeled round time (roofline
     from ``round_cost_est``) against the median measured round, the
@@ -509,6 +547,9 @@ def render_fit(fit_id: str, fit_events: List[dict]) -> str:
     cost = round_cost_line(fit_events)
     if cost:
         lines.append(cost)
+    samp = sampling_line(fit_events)
+    if samp:
+        lines.append(samp)
     model = cost_model_line(fit_events)
     if model:
         lines.append(model)
